@@ -1,0 +1,23 @@
+"""Test harness config.
+
+Forces an 8-device virtual CPU platform *before* jax is imported anywhere,
+so sharding/collective tests exercise a real multi-device mesh without TPU
+hardware (SURVEY.md §4 "Implication for the new framework"). The axon TPU
+plugin may still register; tests that need the mesh pull devices explicitly
+via tf_yarn_tpu.parallel.mesh.test_devices().
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep compilation deterministic and quick on the test platform.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+# Task subprocesses launched by LocalBackend must import tf_yarn_tpu too.
+os.environ["PYTHONPATH"] = _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")

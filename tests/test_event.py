@@ -1,0 +1,59 @@
+"""Event-protocol tests (stage keys, exception formatting, timers)."""
+
+import time
+
+from tf_yarn_tpu import event
+from tf_yarn_tpu.coordination import InProcessKV
+
+
+def test_lifecycle_stage_keys():
+    kv = InProcessKV()
+    event.init_event(kv, "worker:1", "host:1234")
+    event.start_event(kv, "worker:1")
+    event.stop_event(kv, "worker:1")
+    event.logs_event(kv, "worker:1", "/logs/worker-1.log")
+    event.url_event(kv, "worker:1", "http://host:6006")
+    assert kv.get_str("worker:1/init") == "host:1234"
+    assert kv.get_str("worker:1/start") == ""
+    assert kv.get_str("worker:1/stop") == ""
+    assert kv.get_str("worker:1/logs") == "/logs/worker-1.log"
+    assert kv.get_str("worker:1/url") == "http://host:6006"
+
+
+def test_stop_event_carries_traceback():
+    kv = InProcessKV()
+    try:
+        raise ValueError("boom")
+    except ValueError as exc:
+        event.stop_event(kv, "chief:0", exc)
+    payload = kv.get_str("chief:0/stop")
+    assert "ValueError: boom" in payload
+    assert "Traceback" in payload
+
+
+def test_maybe_format_exception_none():
+    assert event.maybe_format_exception(None) == ""
+
+
+def test_timer_events_are_floats():
+    kv = InProcessKV()
+    before = time.time()
+    event.start_time_event(kv, "worker:0")
+    event.train_eval_start_event(kv, "worker:0")
+    event.train_eval_stop_event(kv, "worker:0")
+    event.stop_time_event(kv, "worker:0")
+    after = time.time()
+    for stage in (
+        event.CONTAINER_START_TIME,
+        event.TRAIN_EVAL_START_TIME,
+        event.TRAIN_EVAL_STOP_TIME,
+        event.CONTAINER_STOP_TIME,
+    ):
+        ts = float(kv.get_str(f"worker:0/{stage}"))
+        assert before <= ts <= after
+
+
+def test_wait_helper():
+    kv = InProcessKV()
+    kv.put_str("k", "v")
+    assert event.wait(kv, "k", timeout=1.0) == "v"

@@ -1,0 +1,67 @@
+"""Tests for _internal utilities (reference: tests/test__internal.py)."""
+
+import os
+import socket
+
+import pytest
+
+from tf_yarn_tpu._internal import (
+    MonitoredThread,
+    expand_tasks,
+    iter_tasks,
+    reserve_sock_addr,
+    xset_environ,
+)
+
+
+def test_monitored_thread_success():
+    thread = MonitoredThread(target=lambda: None)
+    thread.start()
+    thread.join()
+    assert thread.state == "SUCCEEDED"
+    assert thread.exception is None
+
+
+def test_monitored_thread_failure():
+    def boom():
+        raise RuntimeError("train crashed")
+
+    thread = MonitoredThread(target=boom)
+    thread.start()
+    thread.join()
+    assert thread.state == "FAILED"
+    assert isinstance(thread.exception, RuntimeError)
+
+
+def test_reserve_sock_addr_holds_port():
+    # The reserved port must stay bound (reference: tests/test__internal.py:27-34).
+    with reserve_sock_addr() as (host, port):
+        assert port > 0
+        with pytest.raises(OSError):
+            probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                probe.bind(("", port))
+            finally:
+                probe.close()
+
+
+def test_iter_tasks_order():
+    assert list(iter_tasks({"chief": 1, "worker": 2})) == [
+        "chief:0",
+        "worker:0",
+        "worker:1",
+    ]
+
+
+def test_expand_tasks_inverse():
+    tasks = ["chief:0", "worker:0", "worker:1"]
+    assert expand_tasks(tasks) == {"chief": 1, "worker": 2}
+
+
+def test_xset_environ_refuses_clobber():
+    xset_environ(TPU_YARN_TEST_UNIQUE="1")
+    try:
+        with pytest.raises(RuntimeError):
+            xset_environ(TPU_YARN_TEST_UNIQUE="2")
+    finally:
+        del os.environ["TPU_YARN_TEST_UNIQUE"]

@@ -1,0 +1,111 @@
+"""Coordination-store tests: in-process KV, TCP server, and protocol.
+
+Covers the surface the reference exercises through skein's KV plus our
+extensions (events log, incr). Mirrors the reference's dict-KV test style
+(reference: tests/test_client.py:43-50) but also runs the real server.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tf_yarn_tpu.coordination import (
+    InProcessKV,
+    KVClient,
+    KVTimeoutError,
+    start_server,
+)
+
+
+@pytest.fixture(params=["inprocess", "tcp"])
+def kv(request):
+    if request.param == "inprocess":
+        yield InProcessKV()
+    else:
+        server = start_server()
+        try:
+            yield KVClient(server.endpoint)
+        finally:
+            server.stop()
+
+
+def test_put_get_roundtrip(kv):
+    assert kv.get("missing") is None
+    kv.put("a", b"\x00\xffbinary")
+    assert kv.get("a") == b"\x00\xffbinary"
+    kv.put_str("b", "text")
+    assert kv.get_str("b") == "text"
+
+
+def test_wait_returns_existing_value(kv):
+    kv.put("ready", b"v")
+    assert kv.wait("ready", timeout=1.0) == b"v"
+
+
+def test_wait_blocks_until_put(kv):
+    result = {}
+
+    def waiter():
+        result["value"] = kv.wait("later", timeout=10.0)
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    time.sleep(0.1)
+    kv.put("later", b"arrived")
+    thread.join(timeout=5.0)
+    assert result["value"] == b"arrived"
+
+
+def test_wait_timeout(kv):
+    with pytest.raises(KVTimeoutError):
+        kv.wait("never", timeout=0.1)
+
+
+def test_events_log(kv):
+    kv.put("x", b"1")
+    kv.put("y", b"2")
+    events, nxt = kv.events(0)
+    assert [k for _, k in events] == ["x", "y"]
+    kv.put("z", b"3")
+    events, nxt2 = kv.events(nxt)
+    assert [k for _, k in events] == ["z"]
+    assert nxt2 == nxt + 1
+
+
+def test_keys_prefix(kv):
+    kv.put("task:0/init", b"")
+    kv.put("task:0/start", b"")
+    kv.put("other", b"")
+    assert kv.keys("task:0/") == ["task:0/init", "task:0/start"]
+
+
+def test_incr_atomic(kv):
+    assert kv.incr("counter") == 1
+    assert kv.incr("counter", 5) == 6
+    assert kv.get("counter") == b"6"
+
+
+def test_incr_concurrent(kv):
+    def bump():
+        for _ in range(20):
+            kv.incr("ticket")
+
+    threads = [threading.Thread(target=bump) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert kv.get("ticket") == b"80"
+
+
+def test_delete(kv):
+    kv.put("gone", b"x")
+    kv.delete("gone")
+    assert kv.get("gone") is None
+
+
+def test_large_value(kv):
+    blob = b"q" * (2 * 1024 * 1024)
+    kv.put("big", blob)
+    assert kv.get("big") == blob

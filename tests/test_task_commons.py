@@ -1,0 +1,92 @@
+"""Task-commons tests (reference: tests/test__task_commons.py)."""
+
+import json
+import os
+
+import cloudpickle
+import pytest
+
+from tf_yarn_tpu import _task_commons, constants
+from tf_yarn_tpu.coordination import InProcessKV
+from tf_yarn_tpu.topologies import TaskInstance, TaskKey
+
+
+def _cluster(*specs):
+    return [TaskInstance(TaskKey(t, i), n) for t, i, n in specs]
+
+
+def test_get_task_key_from_env(monkeypatch):
+    monkeypatch.setenv(constants.ENV_TASK_KEY, "worker:2")
+    assert _task_commons.get_task_key() == TaskKey("worker", 2)
+    assert _task_commons.get_task() == "worker:2"
+
+
+def test_n_try_default(monkeypatch):
+    monkeypatch.delenv(constants.ENV_N_TRY, raising=False)
+    assert _task_commons.n_try() == 0
+    monkeypatch.setenv(constants.ENV_N_TRY, "3")
+    assert _task_commons.n_try() == 3
+
+
+def test_get_cluster_tasks_roundtrip():
+    kv = InProcessKV()
+    kv.put_str(
+        constants.KV_CLUSTER_INSTANCES,
+        json.dumps([["chief:0", 1], ["worker:0", 2], ["worker:1", 2]]),
+    )
+    tasks = _task_commons.get_cluster_tasks(kv, timeout=1.0)
+    assert tasks == _cluster(("chief", 0, 1), ("worker", 0, 2), ("worker", 1, 2))
+    assert _task_commons.compute_world_size(tasks) == 5
+
+
+def test_compute_rank_chief_first():
+    tasks = _cluster(("worker", 0, 2), ("chief", 0, 1), ("worker", 1, 2))
+    assert _task_commons.compute_rank(TaskKey("chief", 0), tasks) == 0
+    assert _task_commons.compute_rank(TaskKey("worker", 0), tasks) == 1
+    assert _task_commons.compute_rank(TaskKey("worker", 1), tasks, local_rank=1) == 4
+    with pytest.raises(ValueError):
+        _task_commons.compute_rank(TaskKey("worker", 9), tasks)
+
+
+def test_is_chief_worker_only_topology():
+    # Reference KeyErrors on chief-less clusters (SURVEY §2.6); we elect worker:0.
+    tasks = _cluster(("worker", 0, 1), ("worker", 1, 1))
+    assert _task_commons.is_chief(TaskKey("worker", 0), tasks)
+    assert not _task_commons.is_chief(TaskKey("worker", 1), tasks)
+
+
+def test_choose_master_election():
+    kv = InProcessKV()
+    tasks = _cluster(("chief", 0, 1), ("worker", 0, 1))
+    addr = _task_commons.choose_master(kv, TaskKey("chief", 0), tasks)
+    assert kv.get_str("MASTER_ADDR") == addr
+    # A non-chief just reads the broadcast.
+    addr2 = _task_commons.choose_master(kv, TaskKey("worker", 0), tasks, timeout=1.0)
+    assert addr2 == addr
+    host, _, port = addr.rpartition(":")
+    assert int(port) > 0
+    for var in ("MASTER_ADDR", "MASTER_PORT"):
+        os.environ.pop(var, None)
+
+
+def test_get_experiment_success(monkeypatch):
+    monkeypatch.setenv(constants.ENV_TASK_KEY, "worker:0")
+    kv = InProcessKV()
+    kv.put(constants.KV_EXPERIMENT_FN, cloudpickle.dumps(lambda: {"model": 42}))
+    assert _task_commons.get_experiment(kv) == {"model": 42}
+
+
+def test_get_experiment_failure_emits_events(monkeypatch):
+    # Unpickling/calling failures broadcast start+stop so the driver can
+    # attribute them (reference: _task_commons.py:55-63).
+    monkeypatch.setenv(constants.ENV_TASK_KEY, "worker:0")
+    kv = InProcessKV()
+
+    def broken():
+        raise RuntimeError("bad experiment")
+
+    kv.put(constants.KV_EXPERIMENT_FN, cloudpickle.dumps(broken))
+    with pytest.raises(RuntimeError, match="bad experiment"):
+        _task_commons.get_experiment(kv)
+    assert kv.get_str("worker:0/start") == ""
+    assert "bad experiment" in kv.get_str("worker:0/stop")

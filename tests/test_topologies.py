@@ -1,0 +1,90 @@
+"""Topology validation tests (reference: tests/test_topologies.py)."""
+
+import pytest
+
+from tf_yarn_tpu.topologies import (
+    MAX_CHIPS_PER_HOST,
+    MAX_HOST_MEMORY_GIB,
+    NodeLabel,
+    TaskKey,
+    TaskSpec,
+    allreduce_topology,
+    check_topology,
+    compute_nb_chips,
+    compute_nb_hosts,
+    single_server_topology,
+    tpu_slice_topology,
+)
+
+
+def test_task_key_roundtrip():
+    key = TaskKey("worker", 3)
+    assert key.to_kv_str() == "worker:3"
+    assert TaskKey.from_kv_str("worker:3") == key
+
+
+def test_task_spec_limits():
+    with pytest.raises(ValueError):
+        TaskSpec(memory_gib=MAX_HOST_MEMORY_GIB + 1)
+    with pytest.raises(ValueError):
+        TaskSpec(chips_per_host=MAX_CHIPS_PER_HOST + 1, label=NodeLabel.TPU)
+    with pytest.raises(ValueError):
+        TaskSpec(label=NodeLabel.TPU, chips_per_host=0)
+    with pytest.raises(ValueError):
+        TaskSpec(label=NodeLabel.CPU, chips_per_host=2)
+
+
+def test_unknown_task_type_rejected():
+    with pytest.raises(ValueError, match="ps"):
+        check_topology({"ps": TaskSpec(instances=1)})
+
+
+def test_multiple_chiefs_rejected():
+    with pytest.raises(ValueError):
+        check_topology(
+            {"chief": TaskSpec(instances=2, chips_per_host=1, label=NodeLabel.TPU)}
+        )
+
+
+def test_worker_only_topology_is_valid():
+    # The reference KeyErrors here (topologies.py:101, SURVEY §2.6); we accept.
+    check_topology(
+        {"worker": TaskSpec(instances=4, chips_per_host=4, label=NodeLabel.TPU)}
+    )
+
+
+def test_evaluator_cannot_reserve_chips():
+    with pytest.raises(ValueError):
+        check_topology(
+            {
+                "worker": TaskSpec(instances=1, chips_per_host=1, label=NodeLabel.TPU),
+                "evaluator": TaskSpec(
+                    instances=1, chips_per_host=1, label=NodeLabel.TPU
+                ),
+            }
+        )
+
+
+def test_single_server_topology():
+    specs = single_server_topology(chips=4)
+    assert specs["chief"].instances == 1
+    assert compute_nb_chips(specs) == 4
+
+
+def test_allreduce_topology():
+    specs = allreduce_topology(nb_workers=3, chips_per_host=4, with_evaluator=True)
+    assert compute_nb_hosts(specs) == 5
+    assert compute_nb_chips(specs) == 16
+    assert specs["evaluator"].label is NodeLabel.CPU
+
+
+def test_tpu_slice_topology_v5e16():
+    specs = tpu_slice_topology("v5e-16", with_tensorboard=True)
+    assert specs["chief"].chips_per_host == 4
+    assert specs["worker"].instances == 3
+    assert compute_nb_chips(specs) == 16
+
+
+def test_tpu_slice_topology_unknown():
+    with pytest.raises(ValueError, match="unknown slice type"):
+        tpu_slice_topology("v99-1")

@@ -1,0 +1,31 @@
+"""tf_yarn_tpu — a TPU-native distributed-training launcher & framework.
+
+Brand-new implementation of the capability surface of criteo/tf-yarn
+(reference mounted at /root/reference; structural map in SURVEY.md),
+re-designed for TPU: slice placement instead of YARN containers, an
+in-repo coordination service instead of the skein ApplicationMaster, and
+JAX/XLA collectives over ICI instead of ParameterServerStrategy, Horovod/
+Gloo and NCCL.
+
+Public surface (analog of reference tf_yarn/__init__.py:1-8):
+"""
+
+from tf_yarn_tpu.topologies import (  # noqa: F401
+    NodeLabel,
+    TaskKey,
+    TaskSpec,
+    allreduce_topology,
+    single_server_topology,
+    tpu_slice_topology,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NodeLabel",
+    "TaskKey",
+    "TaskSpec",
+    "allreduce_topology",
+    "single_server_topology",
+    "tpu_slice_topology",
+]

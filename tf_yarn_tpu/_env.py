@@ -1,0 +1,22 @@
+"""Task-type → task-program mapping (reference: tf_yarn/_env.py:10-24).
+
+Each task instance runs ``python -m <module>``; this keeps the reference's
+`custom_task_module` seam so alternative task programs stay pluggable
+(SURVEY.md §7.5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+WORKER_MODULE = "tf_yarn_tpu.tasks.worker"
+TENSORBOARD_MODULE = "tf_yarn_tpu.tasks.tensorboard"
+EVALUATOR_MODULE = "tf_yarn_tpu.tasks.evaluator"
+
+
+def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) -> str:
+    if task_type == "tensorboard":
+        return TENSORBOARD_MODULE
+    if task_type == "evaluator":
+        return EVALUATOR_MODULE
+    return custom_task_module or WORKER_MODULE

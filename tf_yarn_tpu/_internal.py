@@ -1,0 +1,95 @@
+"""Low-level process/thread/socket utilities.
+
+Port of the reference's `_internal.py` surface (reference:
+tf_yarn/_internal.py:22-96): exception-capturing threads, race-free port
+reservation, task iteration, exclusive environment mutation.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import socket
+import threading
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class MonitoredThread(threading.Thread):
+    """A thread that records the exception its target raised.
+
+    States mirror the reference (reference: _internal.py:22-45): RUNNING
+    while alive, FAILED if the target raised, SUCCEEDED otherwise. Task
+    programs run user training functions inside one of these and ship the
+    captured exception as the `stop`-event payload.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._exc: Optional[BaseException] = None
+
+    @property
+    def state(self) -> str:
+        if self.is_alive():
+            return "RUNNING"
+        return "FAILED" if self._exc is not None else "SUCCEEDED"
+
+    @property
+    def exception(self) -> Optional[BaseException]:
+        return self._exc
+
+    def run(self) -> None:
+        try:
+            super().run()
+        except BaseException as exc:  # noqa: B036 — deliberate: report everything
+            self._exc = exc
+
+
+def get_so_reuseport() -> Optional[int]:
+    """SO_REUSEPORT if this platform has it (reference: _internal.py:48-57)."""
+    if platform.system() in ("Linux", "Darwin"):
+        return getattr(socket, "SO_REUSEPORT", None)
+    return None
+
+
+@contextmanager
+def reserve_sock_addr() -> Iterator[Tuple[str, int]]:
+    """Reserve an address by binding port 0 and *keeping the socket open*.
+
+    The held-open SO_REUSEPORT socket lets the eventual server bind the same
+    port while preventing anyone else from grabbing it in between — the
+    reference's fix for the TF port race (reference: _internal.py:60-80,
+    note at tensorflow/cluster.py:29-34).
+    """
+    so_reuseport = get_so_reuseport()
+    if so_reuseport is None:
+        raise RuntimeError("SO_REUSEPORT is not supported on this platform")
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+        sock.setsockopt(socket.SOL_SOCKET, so_reuseport, 1)
+        sock.bind(("", 0))
+        _, port = sock.getsockname()
+        yield (socket.getfqdn(), port)
+
+
+def iter_tasks(tasks_by_type: Dict[str, int]) -> Iterator[str]:
+    """Yield "type:id" for every instance (reference: _internal.py:83-87)."""
+    for task_type, count in tasks_by_type.items():
+        for task_id in range(count):
+            yield f"{task_type}:{task_id}"
+
+
+def xset_environ(**kwargs: str) -> None:
+    """Set env vars, refusing to clobber (reference: _internal.py:90-96)."""
+    for key, value in kwargs.items():
+        if key in os.environ:
+            raise RuntimeError(f"environment variable {key} is already set")
+        os.environ[key] = value
+
+
+def expand_tasks(tasks: List[str]) -> Dict[str, int]:
+    """Inverse of :func:`iter_tasks`: count instances per type."""
+    counts: Dict[str, int] = {}
+    for task in tasks:
+        task_type = task.split(":", 1)[0]
+        counts[task_type] = counts.get(task_type, 0) + 1
+    return counts

@@ -1,0 +1,258 @@
+"""Slice backends: how task programs get placed onto machines.
+
+The reference delegates placement to YARN through skein services
+(reference: client.py:210-263 builds one `skein.Service` per task type and
+`submit_and_connect`s). On TPU there is no resource manager in the loop, so
+placement is a first-class, pluggable seam:
+
+* :class:`LocalBackend` — every task instance is a subprocess on this host.
+  Serves two roles: single-host TPU-VM runs (the common case: one process
+  drives all local chips) and the *real-process* integration harness for
+  CI (SURVEY.md §4's "fake backend" requirement — no mocks, actual
+  processes coordinating through the actual KV service).
+* :class:`SshBackend` — one task runner per TPU-VM worker over ssh; the
+  multi-host path (the analog of YARN launching containers on many nodes).
+
+A backend receives fully-resolved :class:`ServiceSpec`s (module to run,
+instance count, env) and returns a :class:`ClusterHandle` the driver polls —
+the analog of the skein application handle.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import subprocess
+import sys
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tf_yarn_tpu import constants
+from tf_yarn_tpu.topologies import TaskKey
+
+_logger = logging.getLogger(__name__)
+
+# Final statuses, mirroring YARN's (reference: client.py:557-599 polls
+# `application_report.final_status` in {"succeeded","failed","killed"}).
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+KILLED = "KILLED"
+
+# Side-cars don't gate run completion: the reference's evaluator and
+# tensorboard self-terminate after the training tasks stop
+# (evaluator_task.py:21-35, _tensorboard_task.py:54-58).
+PRIMARY_TASK_TYPES = ("chief", "worker")
+
+
+@dataclass
+class ServiceSpec:
+    """One task type's launch recipe (the skein.Service analog)."""
+
+    module: str
+    instances: int
+    env: Dict[str, str] = field(default_factory=dict)
+    nb_proc: int = 1
+    pre_script_hook: str = ""
+
+
+class ClusterHandle(ABC):
+    """A launched set of task programs the driver can poll / kill."""
+
+    @abstractmethod
+    def status(self) -> str:
+        """RUNNING until all primary tasks exit, then SUCCEEDED/FAILED."""
+
+    @abstractmethod
+    def tasks(self) -> List[TaskKey]:
+        ...
+
+    @abstractmethod
+    def kill(self) -> None:
+        ...
+
+    @abstractmethod
+    def logs(self) -> Dict[str, str]:
+        """task "type:id" -> log location (file path or URL)."""
+
+
+class SliceBackend(ABC):
+    @abstractmethod
+    def launch(
+        self, services: Dict[str, ServiceSpec], log_dir: str
+    ) -> ClusterHandle:
+        ...
+
+
+class _LocalHandle(ClusterHandle):
+    def __init__(
+        self,
+        procs: Dict[TaskKey, subprocess.Popen],
+        log_files: Dict[TaskKey, str],
+    ) -> None:
+        self._procs = procs
+        self._log_files = log_files
+        self._killed = False
+
+    def status(self) -> str:
+        primary = [
+            (key, proc)
+            for key, proc in self._procs.items()
+            if key.type in PRIMARY_TASK_TYPES
+        ]
+        if not primary:  # side-car-only app: gate on everything
+            primary = list(self._procs.items())
+        if any(proc.poll() is None for _, proc in primary):
+            return RUNNING
+        if self._killed:
+            return KILLED
+        if all(proc.returncode == 0 for _, proc in primary):
+            return SUCCEEDED
+        return FAILED
+
+    def tasks(self) -> List[TaskKey]:
+        return list(self._procs)
+
+    def kill(self) -> None:
+        self._killed = True
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def reap_sidecars(self, timeout: float = 10.0) -> None:
+        """Stop side-cars that outlive the primaries (TB lingers by design)."""
+        for key, proc in self._procs.items():
+            if key.type in PRIMARY_TASK_TYPES or proc.poll() is not None:
+                continue
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    def logs(self) -> Dict[str, str]:
+        return {key.to_kv_str(): path for key, path in self._log_files.items()}
+
+
+class LocalBackend(SliceBackend):
+    """Run every task instance as a local subprocess.
+
+    The per-task command is ``python -m <module>`` with identity/coordinator
+    env vars — the same contract `_env.gen_task_module` defines for every
+    backend (reference container command: _env.py:10-24).
+    """
+
+    def __init__(self, python: Optional[str] = None) -> None:
+        self._python = python or sys.executable
+
+    def launch(
+        self, services: Dict[str, ServiceSpec], log_dir: str
+    ) -> _LocalHandle:
+        os.makedirs(log_dir, exist_ok=True)
+        procs: Dict[TaskKey, subprocess.Popen] = {}
+        log_files: Dict[TaskKey, str] = {}
+        for task_type, spec in services.items():
+            for task_id in range(spec.instances):
+                key = TaskKey(task_type, task_id)
+                env = dict(os.environ)
+                env.update(spec.env)
+                env[constants.ENV_TASK_KEY] = key.to_kv_str()
+                log_path = os.path.join(log_dir, f"{task_type}-{task_id}.log")
+                log_files[key] = log_path
+                log_file = open(log_path, "wb")
+                cmd = [self._python, "-m", spec.module]
+                if spec.pre_script_hook:
+                    shell = f"{spec.pre_script_hook}; exec {shlex.join(cmd)}"
+                    procs[key] = subprocess.Popen(
+                        ["/bin/sh", "-c", shell],
+                        env=env,
+                        stdout=log_file,
+                        stderr=subprocess.STDOUT,
+                    )
+                else:
+                    procs[key] = subprocess.Popen(
+                        cmd, env=env, stdout=log_file, stderr=subprocess.STDOUT
+                    )
+                log_file.close()
+                _logger.info("launched %s as pid %d", key, procs[key].pid)
+        return _LocalHandle(procs, log_files)
+
+
+@dataclass
+class TpuVmHost:
+    """One TPU VM worker reachable over ssh."""
+
+    hostname: str
+    worker_index: int
+
+
+class SshBackend(SliceBackend):
+    """Place one task runner per TPU-VM worker over ssh.
+
+    The multi-host analog of YARN container launch: host *i* of the slice
+    runs the *i*-th task instance (chief = worker 0, SURVEY.md §7.2). The
+    remote side needs this package importable (env packaging — the
+    reference ships a pex through HDFS, packaging.py; here a shared
+    filesystem / pre-provisioned image fills that role, with `remote_prefix`
+    pointing at the code root).
+    """
+
+    def __init__(
+        self,
+        hosts: List[TpuVmHost],
+        python: str = "python3",
+        remote_prefix: str = "",
+        ssh_options: Optional[List[str]] = None,
+    ) -> None:
+        self._hosts = hosts
+        self._python = python
+        self._remote_prefix = remote_prefix
+        self._ssh_options = ssh_options or ["-o", "StrictHostKeyChecking=no"]
+
+    def launch(
+        self, services: Dict[str, ServiceSpec], log_dir: str
+    ) -> _LocalHandle:
+        os.makedirs(log_dir, exist_ok=True)
+        assignments: List[Tuple[TaskKey, ServiceSpec]] = []
+        for task_type in ("chief", "worker", "evaluator", "tensorboard"):
+            spec = services.get(task_type)
+            if spec is None:
+                continue
+            for task_id in range(spec.instances):
+                assignments.append((TaskKey(task_type, task_id), spec))
+        if len(assignments) > len(self._hosts):
+            raise ValueError(
+                f"{len(assignments)} task instances > {len(self._hosts)} TPU VM hosts"
+            )
+        procs: Dict[TaskKey, subprocess.Popen] = {}
+        log_files: Dict[TaskKey, str] = {}
+        for host, (key, spec) in zip(self._hosts, assignments):
+            env_exports = " ".join(
+                f"{k}={shlex.quote(v)}"
+                for k, v in {**spec.env, constants.ENV_TASK_KEY: key.to_kv_str()}.items()
+            )
+            prefix = f"cd {shlex.quote(self._remote_prefix)} && " if self._remote_prefix else ""
+            hook = f"{spec.pre_script_hook}; " if spec.pre_script_hook else ""
+            remote_cmd = (
+                f"{prefix}{hook}env {env_exports} {self._python} -m {spec.module}"
+            )
+            log_path = os.path.join(log_dir, f"{key.type}-{key.id}.log")
+            log_files[key] = log_path
+            with open(log_path, "wb") as log_file:
+                procs[key] = subprocess.Popen(
+                    ["ssh", *self._ssh_options, host.hostname, remote_cmd],
+                    stdout=log_file,
+                    stderr=subprocess.STDOUT,
+                )
+            _logger.info("launched %s on %s", key, host.hostname)
+        return _LocalHandle(procs, log_files)

@@ -1,0 +1,100 @@
+"""`check_tpu_env` — environment diagnostic CLI.
+
+TPU-native analog of the reference's `check_hadoop_env` console script
+(reference: tf_yarn/bin/check_hadoop_env.py:97-172, wired in setup.py:66-68):
+instead of Hadoop env vars + an HDFS write/read probe + a remote skein app,
+we check JAX/TPU visibility, coordination-service round-trip, and a local
+end-to-end launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import tempfile
+
+_logger = logging.getLogger(__name__)
+
+
+def check_jax() -> bool:
+    try:
+        import jax
+
+        devices = jax.devices()
+        print(f"OK   jax {jax.__version__}, backend={jax.default_backend()}, "
+              f"devices={[str(d) for d in devices]}")
+        return True
+    except Exception as exc:
+        print(f"FAIL jax devices unavailable: {exc}")
+        return False
+
+
+def check_coordination() -> bool:
+    from tf_yarn_tpu.coordination import KVClient
+    from tf_yarn_tpu.coordination.server_factory import start_best_server
+
+    try:
+        server = start_best_server()
+        try:
+            client = KVClient(server.endpoint)
+            client.put("probe", b"ok")
+            assert client.wait("probe", timeout=5.0) == b"ok"
+            print(f"OK   coordination service round-trip ({client.ping()} server "
+                  f"at {server.endpoint})")
+            return True
+        finally:
+            server.stop()
+    except Exception as exc:
+        print(f"FAIL coordination service: {exc}")
+        return False
+
+
+def check_local_run() -> bool:
+    """Launch a real one-task run through the full driver path (the analog
+    of the reference's remote 1-container check, check_hadoop_env.py:56-93)."""
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    probe_file = tempfile.NamedTemporaryFile(delete=False)
+
+    def experiment_fn():
+        def run(params):
+            with open(probe_file.name, "w") as fh:
+                fh.write(f"rank={params.rank}")
+
+        return run
+
+    try:
+        run_on_tpu(
+            experiment_fn,
+            {"worker": TaskSpec(instances=1)},
+            custom_task_module="tf_yarn_tpu.tasks.distributed",
+            name="check_tpu_env",
+            poll_every_secs=0.2,
+        )
+        with open(probe_file.name) as fh:
+            assert fh.read() == "rank=0"
+        print("OK   end-to-end local run (driver -> coordination -> task)")
+        return True
+    except Exception as exc:
+        print(f"FAIL end-to-end local run: {exc}")
+        return False
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--skip-run", action="store_true", help="skip the end-to-end launch probe"
+    )
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.WARNING)
+    ok = check_jax() & check_coordination()
+    if not args.skip_run:
+        ok &= check_local_run()
+    print("all checks passed" if ok else "some checks FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,388 @@
+"""Driver: `run_on_tpu` — submit an experiment onto a TPU slice and await it.
+
+TPU-native rebuild of the reference launcher (reference: tf_yarn/client.py:
+299-466 `run_on_yarn`, 179-270 `_setup_skein_cluster`, 527-631
+`_execute_and_await_termination`, 633-739 event aggregation & metrics).
+The differences are architectural, not cosmetic:
+
+* No YARN: a pluggable :class:`~tf_yarn_tpu.backends.SliceBackend` places
+  task programs on hosts (subprocesses locally, ssh across a TPU pod).
+* No skein AM: the driver starts the in-repo coordination service
+  (native ``coordd`` when built, Python otherwise) and tears it down with
+  the run.
+* The experiment crosses to tasks exactly as in the reference: cloudpickled
+  through the KV store (reference: client.py:281,536).
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import cloudpickle
+
+from tf_yarn_tpu import _env, constants, event
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.backends import (
+    FAILED,
+    KILLED,
+    RUNNING,
+    ClusterHandle,
+    LocalBackend,
+    ServiceSpec,
+    SliceBackend,
+)
+from tf_yarn_tpu.coordination import KVClient, KVStore
+from tf_yarn_tpu.coordination.server_factory import start_best_server
+from tf_yarn_tpu.topologies import (
+    TaskSpec,
+    TaskSpecs,
+    check_topology,
+    single_server_topology,
+)
+from tf_yarn_tpu.utils import mlflow
+from tf_yarn_tpu.utils.evaluator_metrics import EvaluatorMetricsLogger
+from tf_yarn_tpu.utils.metrics import (
+    Metrics,
+    OneShotMetricsLogger,
+    TaskOutcome,
+    handle_events,
+)
+
+_logger = logging.getLogger(__name__)
+
+ExperimentFn = Callable[[], object]
+
+
+class RunFailed(Exception):
+    """Raised when the experiment fails (reference: client.py:89-90)."""
+
+
+@dataclass
+class SliceCluster:
+    """A running cluster: coordination service + launched tasks
+    (the reference's SkeinCluster, client.py:53-59)."""
+
+    server: object
+    kv: KVStore
+    handle: ClusterHandle
+    cluster_tasks: List[str]
+    log_dir: str
+    event_listener: Optional[MonitoredThread] = None
+    events: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+
+def _setup_cluster_spec(task_specs: TaskSpecs, kv: KVStore) -> List[str]:
+    """Post the cluster layout; evaluator/tensorboard are side-cars and not
+    part of the training cluster (reference: client.py:170-176)."""
+    instances = [
+        (f"{task_type}:{task_id}", spec.nb_proc_per_worker)
+        for task_type, spec in task_specs.items()
+        if task_type not in ("evaluator", "tensorboard")
+        for task_id in range(spec.instances)
+    ]
+    kv.put_str(constants.KV_CLUSTER_INSTANCES, json.dumps(instances))
+    return [task for task, _ in instances]
+
+
+def _setup_task_env(
+    task_specs: TaskSpecs,
+    endpoint: str,
+    log_dir: str,
+    n_try: int,
+    env: Dict[str, str],
+    custom_task_module: Optional[str],
+    pre_script_hook: str,
+) -> Dict[str, ServiceSpec]:
+    """Build one ServiceSpec per task type (reference: client.py:108-133
+    `_setup_task_env` + 210-240 service construction)."""
+    services: Dict[str, ServiceSpec] = {}
+    for task_type, spec in task_specs.items():
+        if spec.instances == 0:
+            continue
+        task_env = dict(env)
+        task_env[constants.ENV_COORDINATOR] = endpoint
+        task_env[constants.ENV_N_TRY] = str(n_try)
+        task_env[constants.ENV_LOG_DIR] = log_dir
+        task_env[constants.ENV_NB_PROC] = str(spec.nb_proc_per_worker)
+        # MLflow context crosses to tasks via env, as in the reference
+        # (client.py:124-133) — but only when mlflow is really active (the
+        # reference's `if mlflow.use_mlflow:` bug is fixed here, SURVEY §2.6).
+        if mlflow.use_mlflow():
+            task_env.setdefault("MLFLOW_RUN_ID", mlflow.active_run_id())
+            tracking_uri = mlflow.get_tracking_uri()
+            if tracking_uri:
+                task_env.setdefault("MLFLOW_TRACKING_URI", tracking_uri)
+        if task_type == "tensorboard":
+            if spec.tb_model_dir:
+                task_env.setdefault("TB_MODEL_DIR", spec.tb_model_dir)
+            if spec.tb_extra_args:
+                task_env.setdefault("TB_EXTRA_ARGS", spec.tb_extra_args)
+            task_env.setdefault(
+                "TB_TERMINATION_TIMEOUT_SECONDS",
+                str(spec.tb_termination_timeout_seconds),
+            )
+        services[task_type] = ServiceSpec(
+            module=_env.gen_task_module(task_type, custom_task_module),
+            instances=spec.instances,
+            env=task_env,
+            nb_proc=spec.nb_proc_per_worker,
+            pre_script_hook=pre_script_hook,
+        )
+    return services
+
+
+def _start_event_listener(cluster: SliceCluster) -> MonitoredThread:
+    """Tail the KV event log and record last-seen stage per task
+    (reference: `_aggregate_events`, client.py:633-657)."""
+
+    def listen() -> None:
+        cursor = 0
+        while cluster.handle.status() == RUNNING:
+            tail, cursor = cluster.kv.events(cursor)
+            for _, key in tail:
+                task, _, stage = key.rpartition("/")
+                if task:
+                    value = cluster.kv.get_str(key) or ""
+                    cluster.events.setdefault(task, {})[stage] = value
+                    _logger.info("event %s = %.80s", key, value)
+            time.sleep(0.5)
+
+    thread = MonitoredThread(target=listen, name="event-listener", daemon=True)
+    thread.start()
+    return thread
+
+
+def _setup_cluster(
+    task_specs: TaskSpecs,
+    backend: SliceBackend,
+    n_try: int,
+    env: Dict[str, str],
+    custom_task_module: Optional[str],
+    pre_script_hook: str,
+    name: str,
+    coordinator_bind: str,
+) -> SliceCluster:
+    log_dir = tempfile.mkdtemp(prefix=f"{name}-logs-")
+    server = start_best_server(host=coordinator_bind)
+    try:
+        kv = KVClient(server.endpoint)
+        services = _setup_task_env(
+            task_specs,
+            server.endpoint,
+            log_dir,
+            n_try,
+            env,
+            custom_task_module,
+            pre_script_hook,
+        )
+        cluster_tasks = _setup_cluster_spec(task_specs, kv)
+        handle = backend.launch(services, log_dir)
+    except Exception:
+        server.stop()
+        raise
+    cluster = SliceCluster(
+        server=server,
+        kv=kv,
+        handle=handle,
+        cluster_tasks=cluster_tasks,
+        log_dir=log_dir,
+    )
+    cluster.event_listener = _start_event_listener(cluster)
+    return cluster
+
+
+def _execute_and_await_termination(
+    cluster: SliceCluster,
+    serialized_fn: bytes,
+    n_try: int,
+    poll_every_secs: float,
+    eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
+) -> Metrics:
+    """Post the experiment, poll to completion, fold events into Metrics
+    (reference: client.py:527-631)."""
+    cluster.kv.put(constants.KV_EXPERIMENT_FN, serialized_fn)
+
+    evaluator_logger = EvaluatorMetricsLogger(
+        [t for t in cluster.handle.tasks() if t.type == "evaluator"],
+        cluster.kv,
+        n_try=n_try,
+        log_thresholds=eval_monitor_log_thresholds,
+    )
+    from tf_yarn_tpu.utils.tensorboard_utils import url_event_name
+
+    tb_url_logger = OneShotMetricsLogger(
+        cluster.kv,
+        [
+            (url_event_name(key.to_kv_str()), "tensorboard URL")
+            for key in cluster.handle.tasks()
+            if key.type == "tensorboard"
+        ],
+        n_try,
+    )
+
+    status = RUNNING
+    while status == RUNNING:
+        time.sleep(poll_every_secs)
+        status = cluster.handle.status()
+        evaluator_logger.log()
+        tb_url_logger.log()
+
+    if hasattr(cluster.handle, "reap_sidecars"):
+        cluster.handle.reap_sidecars()
+    if cluster.event_listener is not None:
+        cluster.event_listener.join(timeout=5.0)
+
+    all_tasks = [key.to_kv_str() for key in cluster.handle.tasks()]
+    metrics, outcomes = handle_events(cluster.kv, all_tasks)
+    _log_run_outcome(cluster, status, outcomes)
+    metrics.log_mlflow(n_try)
+
+    # Only training tasks gate run success; a misconfigured side-car must
+    # not turn a finished run into a failure (backends.PRIMARY_TASK_TYPES).
+    failures = {
+        t: o
+        for t, o in outcomes.items()
+        if o.status == "FAILED" and t.split(":", 1)[0] in ("chief", "worker")
+    }
+    sidecar_failures = {
+        t: o
+        for t, o in outcomes.items()
+        if o.status == "FAILED" and t not in failures
+    }
+    for task, outcome in sidecar_failures.items():
+        _logger.warning(
+            "side-car %s failed (run not affected): %s",
+            task,
+            outcome.exception.strip().splitlines()[-1],
+        )
+    if status != "SUCCEEDED" or failures:
+        details = "\n".join(
+            f"{task}: {outcome.exception}" for task, outcome in failures.items()
+        )
+        raise RunFailed(
+            f"run final status {status}; failed tasks: "
+            f"{sorted(failures) or 'none reported'}\n{details}"
+        )
+    return metrics
+
+
+def _log_run_outcome(
+    cluster: SliceCluster, status: str, outcomes: Dict[str, TaskOutcome]
+) -> None:
+    """Print per-task outcome + log locations, archive to MLflow (reference:
+    client.py:577-589 log harvest + 605-617 `_save_logs_to_mlflow`)."""
+    logs = cluster.handle.logs()
+    lines = [f"final status: {status}"]
+    for task in sorted(outcomes):
+        outcome = outcomes[task]
+        lines.append(f"  {task}: {outcome.status}  logs: {logs.get(task, '?')}")
+        if outcome.exception:
+            lines.append(f"    {outcome.exception.strip().splitlines()[-1]}")
+    summary = "\n".join(lines)
+    _logger.info("%s", summary)
+    mlflow.save_text_to_mlflow(summary, "tpu_yarn_run_outcome")
+
+
+def run_on_tpu(
+    experiment_fn: ExperimentFn,
+    task_specs: Optional[TaskSpecs] = None,
+    *,
+    name: str = "tpu_yarn",
+    backend: Optional[SliceBackend] = None,
+    custom_task_module: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+    pre_script_hook: str = "",
+    nb_retries: int = 0,
+    poll_every_secs: float = 0.5,
+    coordinator_bind: str = "127.0.0.1",
+    eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
+) -> Optional[Metrics]:
+    """Run `experiment_fn` on a TPU slice (reference `run_on_yarn`,
+    client.py:299-466; same retry semantics: client.py:431-466).
+
+    `experiment_fn` is a zero-arg closure returning one of the experiment
+    types in `tf_yarn_tpu.experiment` (or, with the `distributed` task
+    module, a function of local_rank). It is cloudpickled to every task;
+    use :func:`get_safe_experiment_fn` when the closure must not capture
+    the driver's module state.
+    """
+    task_specs = dict(task_specs) if task_specs else single_server_topology()
+    check_topology(task_specs)
+    backend = backend or LocalBackend()
+    env = dict(env or {})
+    serialized_fn = cloudpickle.dumps(experiment_fn)
+
+    n_try = 0
+    while True:
+        cluster: Optional[SliceCluster] = None
+        try:
+            cluster = _setup_cluster(
+                task_specs,
+                backend,
+                n_try,
+                env,
+                custom_task_module,
+                pre_script_hook,
+                name,
+                coordinator_bind,
+            )
+            return _execute_and_await_termination(
+                cluster,
+                serialized_fn,
+                n_try,
+                poll_every_secs,
+                eval_monitor_log_thresholds,
+            )
+        except KeyboardInterrupt:
+            _shutdown_on_exception(cluster, KILLED)
+            raise
+        except Exception:
+            _shutdown_on_exception(cluster, FAILED)
+            if n_try < nb_retries:
+                _logger.exception("run attempt %d failed; retrying", n_try)
+                n_try += 1
+                continue
+            raise
+        finally:
+            if cluster is not None:
+                try:
+                    cluster.server.stop()
+                except Exception:  # pragma: no cover - best-effort teardown
+                    pass
+
+
+def _shutdown_on_exception(cluster: Optional[SliceCluster], status: str) -> None:
+    """Kill outstanding tasks on driver exception / Ctrl-C (reference:
+    `_shutdown_on_exception`, client.py:508-524)."""
+    if cluster is None:
+        return
+    try:
+        if cluster.handle.status() == RUNNING:
+            _logger.warning("shutting down run as %s", status)
+            cluster.handle.kill()
+    except Exception:  # pragma: no cover - best-effort teardown
+        _logger.exception("error during shutdown")
+
+
+def get_safe_experiment_fn(full_fn_name: str, *args) -> ExperimentFn:
+    """Reference the experiment function by module path so the pickle holds
+    no driver-env objects (reference: client.py:472-495)."""
+    module_name, _, fn_name = full_fn_name.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"expected 'package.module.function', got {full_fn_name!r}"
+        )
+
+    def _load_and_call(module_name: str, fn_name: str, *inner_args):
+        module = importlib.import_module(module_name)
+        return getattr(module, fn_name)(*inner_args)
+
+    return partial(_load_and_call, module_name, fn_name, *args)

@@ -1,0 +1,38 @@
+"""KV-store key constants.
+
+TPU-native analog of the reference's key registry (reference:
+tf_yarn/constants.py:1-3). Keys are the contract between the driver and
+every task runtime; they live here so both sides agree.
+"""
+
+# JSON list of "type:id" strings for all tasks that belong to the training
+# cluster proper (evaluator/tensorboard excluded, like the reference's
+# KV_CLUSTER_INSTANCES written at client.py:170-176).
+KV_CLUSTER_INSTANCES = "cluster_instances"
+
+# cloudpickled experiment function posted by the driver
+# (reference: client.py:536, read back at _task_commons.py:55-63).
+KV_EXPERIMENT_FN = "experiment_fn"
+
+# JSON-serialized mesh / parallelism spec for the run (new, TPU-specific:
+# the per-task runtime builds its jax.sharding.Mesh from this).
+KV_MESH_SPEC = "mesh_spec"
+
+# Retry counter exported to every task so metric keys from different
+# attempts are distinguishable (reference: TF_YARN_N_TRY, client.py:119).
+ENV_N_TRY = "TPU_YARN_N_TRY"
+
+# Identity of a task process: "type:id" (the reference derives identity
+# from SKEIN_CONTAINER_ID, _task_commons.py:70-72; we set it explicitly).
+ENV_TASK_KEY = "TPU_YARN_TASK"
+
+# host:port of the coordination (KV/event) service.
+ENV_COORDINATOR = "TPU_YARN_COORDINATOR"
+
+# Directory where the task runtime writes its log file (harvested by the
+# driver like YARN log URLs, reference: _task_commons.py:26-34).
+ENV_LOG_DIR = "TPU_YARN_LOG_DIR"
+
+# Number of processes spawned per host for the task (reference:
+# nb_proc_per_worker, topologies.py:54-94).
+ENV_NB_PROC = "TPU_YARN_NB_PROC"

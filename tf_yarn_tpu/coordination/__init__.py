@@ -1,0 +1,19 @@
+from tf_yarn_tpu.coordination.kv import (
+    InProcessKV,
+    KVClient,
+    KVServer,
+    KVStore,
+    KVTimeoutError,
+    connect,
+    start_server,
+)
+
+__all__ = [
+    "InProcessKV",
+    "KVClient",
+    "KVServer",
+    "KVStore",
+    "KVTimeoutError",
+    "connect",
+    "start_server",
+]

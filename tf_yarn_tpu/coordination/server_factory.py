@@ -1,0 +1,79 @@
+"""Pick the best available coordination server: native coordd, else Python.
+
+The native server (tf_yarn_tpu/native/coordd.cc) speaks the same wire
+protocol as :class:`~tf_yarn_tpu.coordination.kv.KVServer`; the driver
+prefers it when its binary has been built (`make -C tf_yarn_tpu/native`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import time
+from typing import Optional
+
+from tf_yarn_tpu.coordination.kv import KVClient, KVServer
+
+_logger = logging.getLogger(__name__)
+
+NATIVE_BINARY = os.path.join(os.path.dirname(__file__), "..", "native", "coordd")
+
+
+class NativeServer:
+    """Handle on a spawned coordd process, same surface as KVServer."""
+
+    def __init__(self, proc: subprocess.Popen, host: str, port: int) -> None:
+        self._proc = proc
+        self._host = host
+        self._port = port
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def stop(self) -> None:
+        try:
+            KVClient(self.endpoint).shutdown_server()
+        except Exception:
+            pass
+        if self._proc.poll() is None:
+            self._proc.terminate()
+        try:
+            self._proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            self._proc.kill()
+
+
+def _free_port(host: str) -> int:
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+def start_native_server(host: str = "127.0.0.1") -> Optional[NativeServer]:
+    binary = os.path.abspath(NATIVE_BINARY)
+    if not os.path.exists(binary):
+        return None
+    port = _free_port(host)
+    proc = subprocess.Popen([binary, host, str(port)])
+    client = KVClient(f"{host}:{port}", connect_timeout=1.0)
+    for _ in range(50):
+        try:
+            if client.ping() == "coordd":
+                _logger.info("native coordd serving on %s:%d", host, port)
+                return NativeServer(proc, host, port)
+        except (ConnectionError, OSError, RuntimeError):
+            time.sleep(0.1)
+    proc.terminate()
+    _logger.warning("native coordd failed to come up; falling back to Python")
+    return None
+
+
+def start_best_server(host: str = "127.0.0.1"):
+    if os.environ.get("TPU_YARN_COORDD", "auto") != "python":
+        native = start_native_server(host)
+        if native is not None:
+            return native
+    return KVServer(host).start()

@@ -1,0 +1,22 @@
+"""Continuous (side-car) evaluation.
+
+Placeholder for the checkpoint-polling evaluator loop (reference:
+tensorflow/tasks/evaluator_task.py:18-158) landing with the checkpoint
+subsystem; for now the side-car simply keeps pace with the training tasks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def continuous_eval(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    _logger.warning(
+        "checkpoint-polling evaluation not yet implemented; waiting for "
+        "training tasks to finish"
+    )
+    _bootstrap.wait_for_all_stops(runtime)

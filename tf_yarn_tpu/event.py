@@ -1,0 +1,97 @@
+"""Lifecycle-event protocol over the coordination KV store.
+
+Faithful port of the reference's event stages so the driver-side poll /
+aggregation loop keeps the same UX (reference: tf_yarn/event.py:13-85 —
+stages ``init`` (sock addr), ``start``, ``stop`` (exception text or ""),
+``logs``, ``url``, plus the timer keys ``container_start_time``,
+``train_eval_start_time``, ``train_eval_stop_time``, ``container_stop_time``
+folded into run Metrics at client.py:660-739).
+
+Keys are ``"{task}/{stage}"`` where ``task`` is the ``"type:id"`` string of
+a :class:`~tf_yarn_tpu.topologies.TaskKey`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import traceback
+from typing import Optional
+
+from tf_yarn_tpu.coordination.kv import KVStore
+
+_logger = logging.getLogger(__name__)
+
+# Lifecycle stages (reference: event.py:33-47).
+INIT = "init"
+START = "start"
+STOP = "stop"
+LOGS = "logs"
+URL = "url"
+
+# Timer stages (reference: event.py:50-67).
+CONTAINER_START_TIME = "container_start_time"
+CONTAINER_STOP_TIME = "container_stop_time"
+TRAIN_EVAL_START_TIME = "train_eval_start_time"
+TRAIN_EVAL_STOP_TIME = "train_eval_stop_time"
+
+
+def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
+    """Block until `key` exists; returns its UTF-8 value (reference: event.py:13-30)."""
+    _logger.info("waiting for %s", key)
+    value = kv.wait_str(key, timeout=timeout)
+    _logger.info("received %s", key)
+    return value
+
+
+def broadcast(kv: KVStore, key: str, value: str = "") -> None:
+    """Publish `key` (reference: event.py:70-79)."""
+    _logger.info("broadcasting %s = %r", key, value[:120])
+    kv.put_str(key, value)
+
+
+def init_event(kv: KVStore, task: str, sock_addr: str) -> None:
+    broadcast(kv, f"{task}/{INIT}", sock_addr)
+
+
+def start_event(kv: KVStore, task: str) -> None:
+    broadcast(kv, f"{task}/{START}")
+
+
+def stop_event(
+    kv: KVStore, task: str, exception: Optional[BaseException] = None
+) -> None:
+    broadcast(kv, f"{task}/{STOP}", maybe_format_exception(exception))
+
+
+def logs_event(kv: KVStore, task: str, logs_location: str) -> None:
+    broadcast(kv, f"{task}/{LOGS}", logs_location)
+
+
+def url_event(kv: KVStore, task: str, url: str) -> None:
+    broadcast(kv, f"{task}/{URL}", url)
+
+
+def start_time_event(kv: KVStore, task: str) -> None:
+    broadcast(kv, f"{task}/{CONTAINER_START_TIME}", str(time.time()))
+
+
+def stop_time_event(kv: KVStore, task: str) -> None:
+    broadcast(kv, f"{task}/{CONTAINER_STOP_TIME}", str(time.time()))
+
+
+def train_eval_start_event(kv: KVStore, task: str) -> None:
+    broadcast(kv, f"{task}/{TRAIN_EVAL_START_TIME}", str(time.time()))
+
+
+def train_eval_stop_event(kv: KVStore, task: str) -> None:
+    broadcast(kv, f"{task}/{TRAIN_EVAL_STOP_TIME}", str(time.time()))
+
+
+def maybe_format_exception(exception: Optional[BaseException]) -> str:
+    """"" for success, full traceback text otherwise (reference: event.py:82-85)."""
+    if exception is None:
+        return ""
+    return "".join(
+        traceback.format_exception(type(exception), exception, exception.__traceback__)
+    )

@@ -1,0 +1,18 @@
+"""Experiment types users return from their experiment_fn.
+
+Placeholder for the experiment adapters (JaxExperiment, KerasExperiment,
+ExperimentSpec, PytorchExperiment) landing with the training loop; the
+worker task dispatches through `EXPERIMENT_TYPES` / `run_experiment`.
+"""
+
+from __future__ import annotations
+
+EXPERIMENT_TYPES: tuple = ()
+
+
+def run_experiment(runtime, experiment) -> None:
+    raise NotImplementedError(
+        "experiment adapters are not available yet; use "
+        'custom_task_module="tf_yarn_tpu.tasks.distributed" for raw '
+        "fn-of-rank jobs"
+    )

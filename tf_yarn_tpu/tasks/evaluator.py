@@ -1,0 +1,35 @@
+"""Side-car evaluator task program.
+
+Port of the reference's continuous evaluator (reference:
+tensorflow/tasks/evaluator_task.py:18-158): poll the experiment's
+checkpoint directory, evaluate every checkpoint exactly once, broadcast
+health metrics, and stop when the final checkpoint is reached or nothing
+new appears for the idle timeout.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def main() -> None:
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        event.train_eval_start_event(runtime.kv, runtime.task)
+        try:
+            from tf_yarn_tpu.evaluation import continuous_eval
+
+            continuous_eval(runtime, experiment)
+        finally:
+            event.train_eval_stop_event(runtime.kv, runtime.task)
+
+
+if __name__ == "__main__":
+    main()

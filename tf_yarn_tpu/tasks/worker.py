@@ -1,0 +1,64 @@
+"""Default task program for chief/worker tasks.
+
+The analog of the reference's `_independent_workers_task` (reference:
+tensorflow/tasks/_independent_workers_task.py:17-47): bootstrap, pull the
+experiment from the KV store, dispatch on its type, run the training
+function in a MonitoredThread, and report lifecycle events throughout.
+
+Dispatch (grown as experiment adapters land):
+* `tf_yarn_tpu.experiment` types (JaxExperiment & friends) — the JAX/pjit
+  train loop (see tf_yarn_tpu.training).
+* a plain callable — invoked with no args (escape hatch).
+For the function-of-rank mode use
+``custom_task_module="tf_yarn_tpu.tasks.distributed"``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def _run_experiment(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    from tf_yarn_tpu import experiment as experiment_mod
+
+    if isinstance(experiment, experiment_mod.EXPERIMENT_TYPES):
+        experiment_mod.run_experiment(runtime, experiment)
+    elif callable(experiment):
+        experiment()
+    else:
+        raise TypeError(
+            f"unsupported experiment type {type(experiment)!r}; expected one "
+            f"of {experiment_mod.EXPERIMENT_TYPES} or a callable (for raw "
+            "fn-of-rank jobs use custom_task_module="
+            '"tf_yarn_tpu.tasks.distributed")'
+        )
+
+
+def main() -> None:
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        event.train_eval_start_event(runtime.kv, runtime.task)
+        # Run in a MonitoredThread so the captured exception carries the
+        # training stack, as in the reference (tf_task_common.py:56-74).
+        thread = MonitoredThread(
+            target=_run_experiment,
+            args=(runtime, experiment),
+            name=f"train-{runtime.task}",
+        )
+        thread.start()
+        thread.join()
+        event.train_eval_stop_event(runtime.kv, runtime.task)
+        if thread.exception is not None:
+            raise thread.exception
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,274 @@
+"""Task topology: resource specs, identities, validators, and presets.
+
+TPU-native redesign of the reference's topology layer (reference:
+tf_yarn/topologies.py:8-160). The reference describes YARN containers
+(memory, vcores, GPU node label); we describe TPU-slice placement: how many
+hosts a task occupies, how many chips each host contributes, and — new,
+because the data plane is compiled XLA collectives rather than PS gRPC —
+the parallelism mesh the chips form (see tf_yarn_tpu/parallel/mesh.py).
+
+Key differences from the reference, by design rather than omission:
+
+* No ``ps`` task type. Parameter servers are an async-DP artifact; on TPU
+  the optimizer state is sharded across the mesh (FSDP axis) and updates
+  ride ICI allreduce, so the role disappears (SURVEY.md §2.4, §7).
+* ``NodeLabel.TPU`` replaces ``NodeLabel.GPU`` (reference: topologies.py:16).
+* Limits are per TPU-VM host instead of per YARN container (reference
+  MAX_MEMORY_CONTAINER/MAX_VCORES_CONTAINER, topologies.py:8-9).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, NamedTuple, Optional, Tuple
+
+# Per-host caps for a v5e/v5p-class TPU VM (the analog of the reference's
+# 48 GiB / 48-vcore YARN container caps, topologies.py:8-9).
+MAX_HOST_MEMORY_GIB = 448
+MAX_HOST_VCORES = 224
+MAX_CHIPS_PER_HOST = 8
+
+ALL_TASK_TYPES = {"chief", "worker", "evaluator", "tensorboard"}
+
+# Known slice shapes: name -> (total chips, hosts). Used by
+# `tpu_slice_topology` to expand a slice type into a host/chip layout.
+SLICE_TYPES: Dict[str, Tuple[int, int]] = {
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 1),
+    "v5e-8": (8, 1),
+    "v5e-16": (16, 4),
+    "v5e-32": (32, 8),
+    "v5e-64": (64, 16),
+    "v5e-128": (128, 32),
+    "v5e-256": (256, 64),
+    "v5p-8": (4, 1),
+    "v5p-16": (8, 2),
+    "v5p-32": (16, 4),
+}
+
+
+class NodeLabel(Enum):
+    """Placement constraint for a task (reference: topologies.py:16-23).
+
+    CPU tasks (evaluator, tensorboard) run on hosts without reserving chips;
+    TPU tasks reserve `chips_per_host` chips on each of their hosts.
+    """
+
+    CPU = ""
+    TPU = "tpu"
+
+
+class TaskKey(NamedTuple):
+    """Identity of one task instance (reference ContainerKey, topologies.py:26-39)."""
+
+    type: str
+    id: int
+
+    def to_kv_str(self) -> str:
+        return f"{self.type}:{self.id}"
+
+    @classmethod
+    def from_kv_str(cls, value: str) -> "TaskKey":
+        task_type, _, task_id = value.partition(":")
+        return cls(task_type, int(task_id))
+
+
+class TaskInstance(NamedTuple):
+    """A TaskKey plus its process count (reference ContainerTask, topologies.py:42-51)."""
+
+    key: TaskKey
+    nb_proc: int
+
+    def to_kv_str(self) -> str:
+        return self.key.to_kv_str()
+
+
+@dataclass
+class TaskSpec:
+    """Resources for every instance of one task type.
+
+    TPU-native analog of the reference TaskSpec (reference:
+    topologies.py:54-94). ``instances`` counts *hosts* (TPU VM workers),
+    ``chips_per_host`` the TPU chips each one contributes to the device
+    mesh, and ``nb_proc_per_worker`` the Python processes per host
+    (normally 1 on TPU: one JAX process drives all local chips).
+    """
+
+    memory_gib: int = 16
+    vcores: int = 8
+    instances: int = 1
+    chips_per_host: int = 0
+    nb_proc_per_worker: int = 1
+    label: NodeLabel = NodeLabel.CPU
+    slice_type: Optional[str] = None
+    # TensorBoard knobs (reference: topologies.py:54-94 tb_* fields).
+    tb_termination_timeout_seconds: int = -1
+    tb_model_dir: Optional[str] = None
+    tb_extra_args: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.memory_gib > MAX_HOST_MEMORY_GIB:
+            raise ValueError(
+                f"memory_gib={self.memory_gib} exceeds host cap {MAX_HOST_MEMORY_GIB}"
+            )
+        if self.vcores > MAX_HOST_VCORES:
+            raise ValueError(
+                f"vcores={self.vcores} exceeds host cap {MAX_HOST_VCORES}"
+            )
+        if not 0 <= self.chips_per_host <= MAX_CHIPS_PER_HOST:
+            raise ValueError(
+                f"chips_per_host={self.chips_per_host} outside [0, {MAX_CHIPS_PER_HOST}]"
+            )
+        if self.label is NodeLabel.TPU and self.chips_per_host == 0:
+            raise ValueError("TPU-labelled tasks must reserve at least one chip")
+        if self.label is NodeLabel.CPU and self.chips_per_host > 0:
+            raise ValueError("CPU-labelled tasks cannot reserve chips")
+        if self.instances < 0 or self.nb_proc_per_worker < 1:
+            raise ValueError("instances must be >= 0 and nb_proc_per_worker >= 1")
+
+    @property
+    def total_chips(self) -> int:
+        return self.instances * self.chips_per_host
+
+
+TaskSpecs = Dict[str, TaskSpec]
+
+
+def _check_general_topology(task_specs: TaskSpecs) -> None:
+    """Structural validation (reference: topologies.py:97-115).
+
+    Unlike the reference — which KeyErrors on chief-less specs
+    (topologies.py:101, §2.6 defect list) — worker-only topologies are
+    valid here: rank 0 of the lowest-ordered task type acts as chief.
+    """
+    unknown = set(task_specs) - ALL_TASK_TYPES
+    if unknown:
+        raise ValueError(
+            f"unknown task types {sorted(unknown)}; expected a subset of "
+            f"{sorted(ALL_TASK_TYPES)} (note: 'ps' does not exist on TPU — "
+            "optimizer state is sharded over the mesh instead)"
+        )
+    if "chief" in task_specs and task_specs["chief"].instances > 1:
+        raise ValueError("at most one chief is allowed")
+    if not any(
+        t in task_specs and task_specs[t].instances > 0 for t in ("chief", "worker")
+    ):
+        raise ValueError("need at least one chief or worker instance")
+    for task_type in ("evaluator", "tensorboard"):
+        if task_type in task_specs and task_specs[task_type].instances > 1:
+            raise ValueError(f"at most one {task_type} is allowed")
+        if task_type in task_specs and task_specs[task_type].label is NodeLabel.TPU:
+            raise ValueError(f"{task_type} is a CPU side-car; it cannot reserve chips")
+
+
+def check_topology(task_specs: TaskSpecs) -> None:
+    _check_general_topology(task_specs)
+
+
+def compute_nb_hosts(task_specs: TaskSpecs) -> int:
+    return sum(spec.instances for spec in task_specs.values())
+
+
+def compute_nb_chips(task_specs: TaskSpecs) -> int:
+    return sum(spec.total_chips for spec in task_specs.values())
+
+
+def single_server_topology(
+    memory_gib: int = 32, vcores: int = 16, chips: int = 1
+) -> TaskSpecs:
+    """One chief driving `chips` local chips (reference: topologies.py:130-141)."""
+    specs = {
+        "chief": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=1,
+            chips_per_host=chips,
+            label=NodeLabel.TPU,
+        )
+    }
+    check_topology(specs)
+    return specs
+
+
+def allreduce_topology(
+    nb_workers: int = 2,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    chips_per_host: int = 4,
+    with_evaluator: bool = False,
+) -> TaskSpecs:
+    """Synchronous-DP topology: chief + workers allreducing over ICI.
+
+    Replaces *both* reference presets — `ps_strategy_topology`
+    (topologies.py:144-160) and the Horovod/Gloo layout
+    (gloo_allred_task.py) — with the one synchronous path TPU uses
+    (SURVEY.md §2.5).
+    """
+    specs: TaskSpecs = {
+        "chief": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=1,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU,
+        ),
+        "worker": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=nb_workers,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU,
+        ),
+    }
+    if with_evaluator:
+        specs["evaluator"] = TaskSpec(
+            memory_gib=memory_gib, vcores=vcores, instances=1, label=NodeLabel.CPU
+        )
+    check_topology(specs)
+    return specs
+
+
+def tpu_slice_topology(
+    slice_type: str = "v5e-16",
+    memory_gib: int = 64,
+    vcores: int = 32,
+    with_evaluator: bool = False,
+    with_tensorboard: bool = False,
+) -> TaskSpecs:
+    """Expand a named slice into chief + workers covering all its hosts."""
+    if slice_type not in SLICE_TYPES:
+        raise ValueError(
+            f"unknown slice type {slice_type!r}; known: {sorted(SLICE_TYPES)}"
+        )
+    total_chips, nb_hosts = SLICE_TYPES[slice_type]
+    chips_per_host = total_chips // nb_hosts
+    specs: TaskSpecs = {
+        "chief": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=1,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU,
+            slice_type=slice_type,
+        )
+    }
+    if nb_hosts > 1:
+        specs["worker"] = TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=nb_hosts - 1,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU,
+            slice_type=slice_type,
+        )
+    if with_evaluator:
+        specs["evaluator"] = TaskSpec(
+            memory_gib=memory_gib, vcores=vcores, instances=1, label=NodeLabel.CPU
+        )
+    if with_tensorboard:
+        specs["tensorboard"] = TaskSpec(
+            memory_gib=8, vcores=4, instances=1, label=NodeLabel.CPU
+        )
+    check_topology(specs)
+    return specs

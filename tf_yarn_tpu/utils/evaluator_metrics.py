@@ -1,0 +1,62 @@
+"""Driver-side poller of evaluator health metrics.
+
+Port of the reference (reference: tf_yarn/evaluator_metrics.py:12-70): the
+side-car evaluator broadcasts its stats into the KV store; the driver polls
+them during the run and logs values that pass optional thresholds.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from tf_yarn_tpu.coordination.kv import KVStore
+from tf_yarn_tpu.topologies import TaskKey
+from tf_yarn_tpu.utils import mlflow
+
+_logger = logging.getLogger(__name__)
+
+# Metric name -> (label, higher-is-better) (reference: evaluator_metrics.py:12-17).
+MONITORED_METRICS = {
+    "awake_time_ratio": "Awake/idle ratio",
+    "eval_step_mean_duration": "Eval step mean duration (secs)",
+    "last_training_step": "Training set of last checkpoint",
+    "nb_eval_steps": "Number of evaluation steps done",
+}
+
+
+class EvaluatorMetricsLogger:
+    """Log evaluator KV metrics, once per changed value, threshold-filtered
+    (reference: evaluator_metrics.py:22-70)."""
+
+    def __init__(
+        self,
+        evaluator_list: List[TaskKey],
+        kv: KVStore,
+        n_try: int = 0,
+        log_thresholds: Optional[Dict[str, Tuple[float, float]]] = None,
+    ) -> None:
+        self.evaluator_list = evaluator_list
+        self.kv = kv
+        self.n_try = n_try
+        self.log_thresholds = log_thresholds or {}
+        self.last_metrics: Dict[str, Dict[str, str]] = {
+            e.to_kv_str(): {} for e in evaluator_list
+        }
+
+    def log(self) -> None:
+        for evaluator in self.evaluator_list:
+            task = evaluator.to_kv_str()
+            for metric, label in MONITORED_METRICS.items():
+                value = self.kv.get_str(f"{task}/{metric}")
+                if value is None or self.last_metrics[task].get(metric) == value:
+                    continue
+                self.last_metrics[task][metric] = value
+                lo, hi = self.log_thresholds.get(metric, (None, None))
+                try:
+                    numeric = float(value)
+                except ValueError:
+                    continue
+                if (lo is None or numeric >= lo) and (hi is None or numeric <= hi):
+                    _logger.info("%s [%s]: %s", label, task, value)
+                mlflow.log_metric(f"{task}_{metric}_{self.n_try}", numeric)

@@ -1,0 +1,56 @@
+"""TensorBoard integration helpers.
+
+Port of the reference (reference: tf_yarn/tensorboard.py:16-58): launch a
+TensorBoard server inside the tensorboard task, advertise its URL through a
+`url` event the driver prints once, and control post-training linger time.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from tf_yarn_tpu import event
+from tf_yarn_tpu.coordination.kv import KVStore
+
+_logger = logging.getLogger(__name__)
+
+DEFAULT_TERMINATION_TIMEOUT_SECONDS = 30
+
+
+def get_termination_timeout() -> int:
+    """Linger time after training stops (reference: tensorboard.py:19-25)."""
+    raw = os.environ.get("TB_TERMINATION_TIMEOUT_SECONDS")
+    try:
+        timeout = int(raw) if raw is not None else -1
+    except ValueError:
+        timeout = -1
+    return timeout if timeout >= 0 else DEFAULT_TERMINATION_TIMEOUT_SECONDS
+
+
+def url_event_name(task: str) -> str:
+    return f"{task}/{event.URL}"
+
+
+def start_tf_board(kv: KVStore, task: str, model_dir: str) -> Optional[object]:
+    """Start `tensorboard.program.TensorBoard` on a free port and broadcast
+    its URL (reference: tensorboard.py:28-49). Returns the board object, or
+    None when tensorboard isn't importable (the run proceeds without it)."""
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "cpp")
+    try:
+        from tensorboard.program import TensorBoard
+
+        board = TensorBoard()
+        argv = ["tensorboard", "--logdir", model_dir, "--port", "0", "--bind_all"]
+        extra = os.environ.get("TB_EXTRA_ARGS")
+        if extra:
+            argv.extend(extra.split())
+        board.configure(argv)
+        url = board.launch()
+        event.url_event(kv, task, url)
+        _logger.info("tensorboard serving %s at %s", model_dir, url)
+        return board
+    except Exception as exc:
+        _logger.warning("could not start tensorboard: %s", exc)
+        return None

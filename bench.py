@@ -1,0 +1,119 @@
+"""Benchmark: flagship training-step throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md: "None"), so vs_baseline
+compares against the value recorded in BENCH_BASELINE.json when present
+(our own previous round), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+def bench_flagship_train(steps: int = 20, warmup: int = 3):
+    import jax
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+    from tf_yarn_tpu.parallel.sharding import tree_shardings, unbox_params
+    from tf_yarn_tpu.training import TrainState, build_train_step
+
+    devices = select_devices()
+    on_tpu = devices[0].platform == "tpu"
+    _log(f"benchmarking on {len(devices)} x {devices[0].device_kind}")
+
+    if on_tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048,
+        )
+        batch_size, seq_len = 8, 1024
+    else:  # CPU smoke fallback so the bench always emits a line
+        config = TransformerConfig.tiny()
+        batch_size, seq_len = 8, 64
+        steps, warmup = 5, 1
+
+    spec = MeshSpec.auto(len(devices))
+    mesh = build_mesh(spec, devices)
+    model = Transformer(config)
+    optimizer = optax.adamw(1e-4)
+    rng = jax.random.PRNGKey(0)
+    tokens = np.random.RandomState(0).randint(
+        0, config.vocab_size, (batch_size, seq_len), dtype=np.int32
+    )
+
+    with mesh:
+        def init_state(rng, tokens):
+            variables = model.init(rng, tokens)
+            params = unbox_params(variables)
+            return TrainState(np.int32(0), params, optimizer.init(params))
+
+        def init_boxed(rng, tokens):
+            variables = model.init(rng, tokens)
+            return TrainState(np.int32(0), variables, optimizer.init(variables))
+
+        abstract = jax.eval_shape(init_boxed, rng, tokens)
+        shardings = tree_shardings(mesh, abstract)
+        state = jax.jit(init_state, out_shardings=shardings)(rng, tokens)
+        step_fn = jax.jit(
+            build_train_step(model, common.lm_loss, optimizer),
+            donate_argnums=(0,),
+            out_shardings=(shardings, None),
+        )
+        batch = {"tokens": jax.device_put(tokens)}
+
+        t0 = time.time()
+        for _ in range(warmup):
+            state, metrics = step_fn(state, batch, rng)
+        jax.block_until_ready(state.params)
+        _log(f"warmup ({warmup} steps incl. compile): {time.time() - t0:.1f}s")
+
+        t0 = time.time()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch, rng)
+        jax.block_until_ready(state.params)
+        elapsed = time.time() - t0
+
+    samples_per_sec = steps * batch_size / elapsed
+    per_chip = samples_per_sec / len(devices)
+    _log(f"{steps} steps in {elapsed:.2f}s; loss={float(metrics['loss']):.3f}")
+    return {
+        "metric": "flagship_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 3),
+        "unit": f"samples/sec/chip (d_model={config.d_model}, "
+        f"layers={config.n_layers}, seq={seq_len}, bf16, "
+        f"{'tpu' if on_tpu else 'cpu-fallback'})",
+    }
+
+
+def main() -> None:
+    result = bench_flagship_train()
+    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs_baseline = 1.0
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as fh:
+                baseline = json.load(fh)
+            if baseline.get("metric") == result["metric"] and baseline.get("value"):
+                vs_baseline = round(result["value"] / float(baseline["value"]), 3)
+        except (ValueError, OSError):
+            pass
+    result["vs_baseline"] = vs_baseline
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,28 @@
-"""Test harness config.
+"""Test harness config: force an 8-device virtual CPU platform.
 
-Forces an 8-device virtual CPU platform *before* jax is imported anywhere,
-so sharding/collective tests exercise a real multi-device mesh without TPU
-hardware (SURVEY.md §4 "Implication for the new framework"). The axon TPU
-plugin may still register; tests that need the mesh pull devices explicitly
-via tf_yarn_tpu.parallel.mesh.test_devices().
+The axon image pre-imports jax in sitecustomize with JAX_PLATFORMS=axon
+(the tunneled TPU), so env vars are already baked by the time conftest
+runs; `jax.config.update` is the only switch that still works — and it
+also keeps tests independent of the axon relay's health. Sharding and
+collective tests then exercise a real multi-device mesh without TPU
+hardware (SURVEY.md §4 "Implication for the new framework").
 """
 
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
-    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# Keep compilation deterministic and quick on the test platform.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+# For task subprocesses (fresh interpreters, sitecustomize runs again):
+# parallel.mesh.select_devices honors TPU_YARN_PLATFORM with a
+# jax.config.update, narrowing backend init to CPU in the child.
+os.environ["TPU_YARN_PLATFORM"] = "cpu"
+
+import jax  # noqa: E402  (imported by sitecustomize already; config still mutable)
+
+jax.config.update("jax_platforms", "cpu")
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)

@@ -5,6 +5,7 @@ extensions (events log, incr). Mirrors the reference's dict-KV test style
 (reference: tests/test_client.py:43-50) but also runs the real server.
 """
 
+import os
 import threading
 import time
 
@@ -16,18 +17,45 @@ from tf_yarn_tpu.coordination import (
     KVTimeoutError,
     start_server,
 )
+from tf_yarn_tpu.coordination.server_factory import start_native_server
+
+_NATIVE = os.path.exists(
+    os.path.join(
+        os.path.dirname(__file__), "..", "tf_yarn_tpu", "native", "coordd"
+    )
+)
 
 
-@pytest.fixture(params=["inprocess", "tcp"])
+@pytest.fixture(
+    params=["inprocess", "tcp"]
+    + (["native"] if _NATIVE else [])
+)
 def kv(request):
     if request.param == "inprocess":
         yield InProcessKV()
-    else:
+    elif request.param == "tcp":
         server = start_server()
         try:
             yield KVClient(server.endpoint)
         finally:
             server.stop()
+    else:
+        server = start_native_server()
+        assert server is not None, "native coordd failed to start"
+        try:
+            yield KVClient(server.endpoint)
+        finally:
+            server.stop()
+
+
+def test_native_server_identifies_itself():
+    if not _NATIVE:
+        pytest.skip("coordd not built")
+    server = start_native_server()
+    try:
+        assert KVClient(server.endpoint).ping() == "coordd"
+    finally:
+        server.stop()
 
 
 def test_put_get_roundtrip(kv):

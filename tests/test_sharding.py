@@ -1,0 +1,66 @@
+"""Sharding-rule unit tests."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+from tf_yarn_tpu.parallel.sharding import (
+    infer_fsdp_partition,
+    logical_to_spec,
+    tree_partition_specs,
+    tree_shardings,
+)
+
+
+def test_mesh_spec_roundtrip():
+    spec = MeshSpec(dp=2, fsdp=2, tp=2)
+    assert spec.total_devices == 8
+    assert MeshSpec.from_json(spec.to_json()) == spec
+
+
+def test_mesh_spec_auto():
+    assert MeshSpec.auto(8) == MeshSpec(fsdp=8)
+
+
+def test_build_mesh_on_cpu_devices():
+    devices = select_devices(8, platform="cpu")
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, tp=2), devices)
+    assert mesh.devices.shape == (1, 2, 2, 1, 2, 1)
+    with pytest.raises(ValueError, match="devices"):
+        build_mesh(MeshSpec(dp=3), devices)
+
+
+def test_logical_to_spec():
+    assert logical_to_spec(("batch", "embed")) == P(("dp", "fsdp"), "fsdp")
+    assert logical_to_spec(("embed", "mlp")) == P("fsdp", "tp")
+    assert logical_to_spec((None, "heads")) == P(None, "tp")
+    assert logical_to_spec(("kv",)) == P(None)
+
+
+def test_infer_fsdp_partition():
+    assert infer_fsdp_partition((128, 64), 8) == P("fsdp", None)
+    assert infer_fsdp_partition((100, 64), 8) == P(None, "fsdp")
+    assert infer_fsdp_partition((7, 13), 8) == P()  # nothing divides
+    assert infer_fsdp_partition((128,), 8) == P()  # 1D stays replicated
+    assert infer_fsdp_partition((128, 64), 1) == P()
+
+
+def test_tree_partition_specs_mixed():
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    boxed = nn.Partitioned(jnp.zeros((4, 16)), names=("embed", "mlp"))
+    tree = {"annotated": boxed, "plain": jnp.zeros((16, 8)), "scalar": jnp.zeros(())}
+    specs = tree_partition_specs(tree, fsdp_size=8)
+    assert specs["annotated"] == P("fsdp", "tp")
+    assert specs["plain"] == P("fsdp", None)
+    assert specs["scalar"] == P()
+
+
+def test_tree_shardings_named():
+    devices = select_devices(8, platform="cpu")
+    mesh = build_mesh(MeshSpec(fsdp=8), devices)
+    tree = {"w": jax.ShapeDtypeStruct((64, 32), "float32")}
+    shardings = tree_shardings(mesh, tree)
+    assert shardings["w"].spec == P("fsdp", None)

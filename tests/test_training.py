@@ -1,0 +1,106 @@
+"""Train-loop tests on the 8-device virtual CPU mesh.
+
+Real training (no mocks): losses must fall, checkpoints must round-trip,
+resume must continue from the saved step — the coverage level SURVEY.md §4
+calls for beyond the reference's mocked CI.
+"""
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+from tf_yarn_tpu.experiment import as_core_experiment
+from tf_yarn_tpu.models import mnist
+from tf_yarn_tpu.parallel.mesh import MeshSpec, select_devices
+from tf_yarn_tpu.training import train_and_evaluate
+
+
+def _mnist_core(tmp_path=None, mesh_spec=None, train_steps=60, **overrides):
+    experiment = mnist.make_experiment(
+        model_dir=str(tmp_path) if tmp_path else None,
+        train_steps=train_steps,
+        batch_size=64,
+        feature_dim=32,
+        num_classes=4,
+        learning_rate=1e-2,
+        mesh_spec=mesh_spec,
+        **overrides,
+    )
+    experiment.model = mnist.DenseClassifier(hidden_sizes=(32, 16), num_classes=4)
+    return as_core_experiment(experiment)
+
+
+def test_train_loss_decreases_fsdp8():
+    core = _mnist_core(mesh_spec=MeshSpec(fsdp=8))
+    metrics = train_and_evaluate(core, devices=select_devices(8, platform="cpu"))
+    assert metrics["loss"] < 1.0  # started ~ln(4)=1.39
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_train_mixed_mesh_dp_fsdp_tp():
+    core = _mnist_core(mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2), train_steps=30)
+    metrics = train_and_evaluate(core, devices=select_devices(8, platform="cpu"))
+    assert np.isfinite(metrics["loss"])
+
+
+def test_checkpoint_and_resume(tmp_path):
+    devices = select_devices(8, platform="cpu")
+    core = _mnist_core(tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=20)
+    train_and_evaluate(core, devices=devices)
+    assert ckpt_lib.latest_checkpoint_step(str(tmp_path)) == 20
+
+    # Resume with a higher step target: loop continues from 20.
+    core2 = _mnist_core(tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=25)
+    train_and_evaluate(core2, devices=devices)
+    steps = ckpt_lib.list_checkpoint_steps(str(tmp_path))
+    assert steps[-1] == 25
+
+    # Same target again: nothing to do, state restored at 25 and re-saved.
+    core3 = _mnist_core(tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=25)
+    train_and_evaluate(core3, devices=devices)
+    assert ckpt_lib.latest_checkpoint_step(str(tmp_path)) == 25
+
+
+def test_eval_loop(tmp_path):
+    core = _mnist_core(
+        mesh_spec=MeshSpec(fsdp=8),
+        train_steps=20,
+        eval_input_fn=lambda: mnist.common.synthetic_classification_iter(64, 32, 4, seed=7),
+    )
+    metrics = train_and_evaluate(core, devices=select_devices(8, platform="cpu"))
+    assert "eval_loss" in metrics
+    assert np.isfinite(metrics["eval_loss"])
+
+
+def test_run_on_tpu_jax_experiment_e2e(tmp_path):
+    """Full path: driver -> subprocess worker -> pjit train loop -> ckpt."""
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    model_dir = str(tmp_path / "model")
+
+    def experiment_fn():
+        from tf_yarn_tpu.models import mnist as mnist_mod
+        from tf_yarn_tpu.parallel.mesh import MeshSpec as MS
+
+        experiment = mnist_mod.make_experiment(
+            model_dir=model_dir,
+            train_steps=8,
+            batch_size=32,
+            feature_dim=16,
+            num_classes=4,
+            mesh_spec=MS(fsdp=8),
+        )
+        experiment.model = mnist_mod.DenseClassifier(
+            hidden_sizes=(16,), num_classes=4
+        )
+        return experiment
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=1)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        poll_every_secs=0.3,
+    )
+    assert metrics.total_training_duration is not None
+    assert ckpt_lib.latest_checkpoint_step(model_dir) == 8

@@ -1,0 +1,80 @@
+"""Checkpoint save/restore with latest-step discovery.
+
+The reference delegates checkpointing to TF Estimator / explicit torch
+saves with epoch-numbered files and regex discovery (reference:
+pytorch/model_ckpt.py:15-73; Estimator `model.ckpt-<step>` parsing in
+evaluator_task.py:130-131). Here checkpoints are orbax pytrees in
+``<model_dir>/ckpt-<step>`` directories: sharded-array aware (each host
+writes its shards — the multi-host story the reference never had) and
+discoverable by the same name-parsing convention so the side-car evaluator
+can diff "checkpoints on disk" vs "checkpoints evaluated".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, List, Optional
+
+_logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def checkpoint_path(model_dir: str, step: int) -> str:
+    return os.path.join(model_dir, f"ckpt-{step}")
+
+
+def list_checkpoint_steps(model_dir: str) -> List[int]:
+    """All completed checkpoint steps, ascending (reference's regex
+    discovery, model_ckpt.py:15-28)."""
+    if not os.path.isdir(model_dir):
+        return []
+    steps = []
+    for entry in os.listdir(model_dir):
+        match = _CKPT_RE.match(entry)
+        if match and os.path.isdir(os.path.join(model_dir, entry)):
+            steps.append(int(match.group(1)))
+    return sorted(steps)
+
+
+def latest_checkpoint_step(model_dir: str) -> Optional[int]:
+    steps = list_checkpoint_steps(model_dir)
+    return steps[-1] if steps else None
+
+
+def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
+    """Write `state` (any pytree of arrays) as ckpt-<step>."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(checkpoint_path(model_dir, step))
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    _logger.info("saved checkpoint %s", path)
+    return path
+
+
+def restore_checkpoint(model_dir: str, step: int, target: Optional[Any] = None) -> Any:
+    """Restore ckpt-<step>; `target` (a pytree of like-shaped arrays or
+    ShapeDtypeStructs with shardings) directs placement on restore."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(checkpoint_path(model_dir, step))
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is None:
+            return ckptr.restore(path)
+        import jax
+
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)
+        return ckptr.restore(path, abstract)
+
+
+def restore_latest(model_dir: str, target: Optional[Any] = None):
+    """(state, step) of the newest checkpoint, or (None, None) — the resume
+    path the retry loop relies on (reference resumes from model_dir,
+    SURVEY.md §5 checkpoint/resume)."""
+    step = latest_checkpoint_step(model_dir)
+    if step is None:
+        return None, None
+    return restore_checkpoint(model_dir, step, target), step

@@ -1,18 +1,228 @@
-"""Experiment types users return from their experiment_fn.
+"""Experiment types users return from their `experiment_fn`.
 
-Placeholder for the experiment adapters (JaxExperiment, KerasExperiment,
-ExperimentSpec, PytorchExperiment) landing with the training loop; the
-worker task dispatches through `EXPERIMENT_TYPES` / `run_experiment`.
+The reference ships three experiment shapes (SURVEY.md §2.2): Estimator
+`Experiment` (tensorflow/experiment.py:6-14), `KerasExperiment`
+(keras_experiment.py:5-11) and `PytorchExperiment` (pytorch/experiment.py:
+30-56). This module supplies their TPU-native counterparts plus the
+first-class JAX shape, all normalizing into one `CoreExperiment` consumed
+by the pjit train loop (tf_yarn_tpu/training.py):
+
+* :class:`JaxExperiment` — flax model + optax optimizer + loss, the
+  flagship path.
+* :class:`ExperimentSpec` (+ :class:`Estimator`, :class:`TrainSpec`,
+  :class:`EvalSpec`) — the Estimator-style triple for users porting
+  `Experiment(estimator, train_spec, eval_spec)` code.
+* :class:`KerasExperiment` — model/model_dir/train_params/input_data_fn
+  shape for users porting Keras jobs.
+* `PytorchExperiment` lives in tf_yarn_tpu/pytorch.py (torch-xla path).
+
+Loss contract everywhere: ``loss_fn(model, params, batch, rng) ->
+(scalar_loss, aux_metrics_dict)`` with ``batch`` a dict of arrays.
 """
 
 from __future__ import annotations
 
-EXPERIMENT_TYPES: tuple = ()
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional
+
+from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+Batch = Dict[str, Any]
+LossFn = Callable[..., Any]  # (model, params, batch, rng) -> (loss, aux)
+InputFn = Callable[[], Iterator[Batch]]
 
 
-def run_experiment(runtime, experiment) -> None:
-    raise NotImplementedError(
-        "experiment adapters are not available yet; use "
-        'custom_task_module="tf_yarn_tpu.tasks.distributed" for raw '
-        "fn-of-rank jobs"
-    )
+@dataclasses.dataclass
+class TrainParams:
+    """Loop control knobs (the analog of the reference's
+    train_spec/eval_spec scalars + KerasExperiment train_params)."""
+
+    train_steps: int
+    eval_every_steps: Optional[int] = None
+    eval_steps: int = 10
+    checkpoint_every_steps: Optional[int] = None
+    log_every_steps: int = 10
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class JaxExperiment:
+    """The TPU-first experiment: everything the train loop needs to pjit.
+
+    `init_fn(rng, batch) -> params` defaults to `model.init(rng, batch)`
+    for single-input models; the model zoo's `make_experiment` helpers set
+    it explicitly.
+    """
+
+    model: Any
+    optimizer: Any
+    loss_fn: LossFn
+    train_input_fn: InputFn
+    train_params: TrainParams
+    model_dir: Optional[str] = None
+    eval_input_fn: Optional[InputFn] = None
+    init_fn: Optional[Callable] = None
+    mesh_spec: Optional[MeshSpec] = None
+
+
+class Estimator:
+    """Estimator-style shim: owns model/loss/optimizer/model_dir (the role
+    of tf.estimator.Estimator in reference experiment.py:6-14)."""
+
+    def __init__(
+        self,
+        model: Any,
+        loss_fn: LossFn,
+        optimizer: Any,
+        model_dir: Optional[str] = None,
+        init_fn: Optional[Callable] = None,
+        mesh_spec: Optional[MeshSpec] = None,
+    ) -> None:
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.model_dir = model_dir
+        self.init_fn = init_fn
+        self.mesh_spec = mesh_spec
+
+    @property
+    def config(self) -> Dict[str, Any]:  # parity: Experiment.config property
+        return {"model_dir": self.model_dir}
+
+
+class TrainSpec(NamedTuple):
+    input_fn: InputFn
+    max_steps: int
+
+
+class EvalSpec(NamedTuple):
+    input_fn: Optional[InputFn] = None
+    steps: int = 10
+    throttle_secs: int = 30  # side-car evaluator poll cadence
+    start_delay_secs: int = 0
+    every_steps: Optional[int] = None  # in-loop eval cadence (None = end only)
+
+
+class ExperimentSpec(NamedTuple):
+    """`Experiment(estimator, train_spec, eval_spec)` parity
+    (reference: tensorflow/experiment.py:6-14)."""
+
+    estimator: Estimator
+    train_spec: TrainSpec
+    eval_spec: Optional[EvalSpec] = None
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self.estimator.config
+
+    @property
+    def model_dir(self) -> Optional[str]:
+        return self.estimator.model_dir
+
+
+@dataclasses.dataclass
+class KerasExperiment:
+    """Keras-shaped experiment (reference: keras_experiment.py:5-11 —
+    model, model_dir, train_params, input_data_fn, target_data_fn,
+    validation_data_fn), extended with the optimizer/loss a compiled Keras
+    model would carry internally."""
+
+    model: Any
+    model_dir: Optional[str]
+    train_params: TrainParams
+    input_data_fn: InputFn
+    optimizer: Any
+    loss_fn: LossFn
+    target_data_fn: Optional[Callable] = None
+    validation_data_fn: Optional[InputFn] = None
+    init_fn: Optional[Callable] = None
+    mesh_spec: Optional[MeshSpec] = None
+
+
+@dataclasses.dataclass
+class CoreExperiment:
+    """Normalized form consumed by training.train_and_evaluate."""
+
+    model: Any
+    optimizer: Any
+    loss_fn: LossFn
+    train_input_fn: InputFn
+    train_params: TrainParams
+    model_dir: Optional[str]
+    eval_input_fn: Optional[InputFn]
+    init_fn: Optional[Callable]
+    mesh_spec: Optional[MeshSpec]
+
+
+def _merge_input_targets(experiment: KerasExperiment) -> InputFn:
+    """Zip Keras-style separate feature/target streams into batch dicts."""
+
+    def input_fn():
+        targets = experiment.target_data_fn() if experiment.target_data_fn else None
+        for features in experiment.input_data_fn():
+            batch = dict(features) if isinstance(features, dict) else {"x": features}
+            if targets is not None:
+                try:
+                    batch["y"] = next(targets)
+                except StopIteration:  # targets exhausted -> epoch over (PEP 479)
+                    return
+            yield batch
+
+    return input_fn
+
+
+def as_core_experiment(experiment: Any) -> CoreExperiment:
+    if isinstance(experiment, JaxExperiment):
+        return CoreExperiment(
+            model=experiment.model,
+            optimizer=experiment.optimizer,
+            loss_fn=experiment.loss_fn,
+            train_input_fn=experiment.train_input_fn,
+            train_params=experiment.train_params,
+            model_dir=experiment.model_dir,
+            eval_input_fn=experiment.eval_input_fn,
+            init_fn=experiment.init_fn,
+            mesh_spec=experiment.mesh_spec,
+        )
+    if isinstance(experiment, ExperimentSpec):
+        estimator = experiment.estimator
+        eval_spec = experiment.eval_spec
+        params = TrainParams(
+            train_steps=experiment.train_spec.max_steps,
+            eval_every_steps=eval_spec.every_steps if eval_spec else None,
+            eval_steps=eval_spec.steps if eval_spec else 10,
+        )
+        return CoreExperiment(
+            model=estimator.model,
+            optimizer=estimator.optimizer,
+            loss_fn=estimator.loss_fn,
+            train_input_fn=experiment.train_spec.input_fn,
+            train_params=params,
+            model_dir=estimator.model_dir,
+            eval_input_fn=eval_spec.input_fn if eval_spec else None,
+            init_fn=estimator.init_fn,
+            mesh_spec=estimator.mesh_spec,
+        )
+    if isinstance(experiment, KerasExperiment):
+        return CoreExperiment(
+            model=experiment.model,
+            optimizer=experiment.optimizer,
+            loss_fn=experiment.loss_fn,
+            train_input_fn=_merge_input_targets(experiment),
+            train_params=experiment.train_params,
+            model_dir=experiment.model_dir,
+            eval_input_fn=experiment.validation_data_fn,
+            init_fn=experiment.init_fn,
+            mesh_spec=experiment.mesh_spec,
+        )
+    raise TypeError(f"cannot normalize experiment of type {type(experiment)!r}")
+
+
+EXPERIMENT_TYPES = (JaxExperiment, ExperimentSpec, KerasExperiment)
+
+
+def run_experiment(runtime, experiment: Any) -> None:
+    """Entry used by tasks/worker.py."""
+    from tf_yarn_tpu import training
+
+    training.train_and_evaluate(as_core_experiment(experiment), runtime=runtime)

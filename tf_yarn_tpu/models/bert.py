@@ -1,0 +1,212 @@
+"""BERT-family encoder — BASELINE.json config 3 (BERT-base fine-tune).
+
+Bidirectional transformer encoder with token/position/segment embeddings,
+GELU MLP, and a classification head; attention rides the same
+tf_yarn_tpu.ops.attention dispatcher as the decoder family (causal=False),
+and parameters carry the same megatron logical names so TP/FSDP placement
+comes from parallel.sharding.LOGICAL_RULES unchanged.
+
+The reference never ships a model — BERT jobs arrive as opaque Keras
+models (reference: examples/native_keras_with_gloo_example.py trains Keras
+over Horovod); here the DP path is ICI allreduce via mesh shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tf_yarn_tpu.models.transformer import EMBED, HEADS, KV, MLP, VOCAB, _partitioned
+from tf_yarn_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    n_segments: int = 2
+    num_classes: int = 2
+    dropout_rate: float = 0.1
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def base(cls, **overrides) -> "BertConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "BertConfig":
+        defaults = dict(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq_len=64, dropout_rate=0.0,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class _Dense(nn.Module):
+    features: int
+    names: tuple
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        kernel = self.param(
+            "kernel",
+            _partitioned(self.names)(nn.initializers.normal(stddev=0.02)),
+            (x.shape[-1], self.features),
+            cfg.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), cfg.param_dtype
+        )
+        return jnp.einsum("...d,df->...f", x, kernel.astype(cfg.dtype)) + bias.astype(
+            cfg.dtype
+        )
+
+
+class EncoderBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        b, s, _ = x.shape
+        q = _Dense(cfg.d_model, (EMBED, HEADS), cfg, name="wq")(x)
+        k = _Dense(cfg.d_model, (EMBED, KV), cfg, name="wk")(x)
+        v = _Dense(cfg.d_model, (EMBED, KV), cfg, name="wv")(x)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        out = attention(q, k, v, impl=cfg.attention_impl, causal=False)
+        out = _Dense(cfg.d_model, (HEADS, EMBED), cfg, name="wo")(
+            out.reshape(b, s, cfg.d_model)
+        )
+        out = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(out)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
+            x + out
+        )
+
+        h = _Dense(cfg.d_ff, (EMBED, MLP), cfg, name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = _Dense(cfg.d_model, (MLP, EMBED), cfg, name="ffn_out")(h)
+        h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
+        return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ffn_norm")(
+            x + h
+        )
+
+
+class BertEncoder(nn.Module):
+    """tokens [B,S] (+ optional segments [B,S]) -> pooled [B, d_model]."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, segments=None, deterministic: bool = True):
+        cfg = self.config
+        tok_emb = self.param(
+            "token_embedding",
+            _partitioned((VOCAB, EMBED))(nn.initializers.normal(stddev=0.02)),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        pos_emb = self.param(
+            "position_embedding",
+            _partitioned((None, EMBED))(nn.initializers.normal(stddev=0.02)),
+            (cfg.max_seq_len, cfg.d_model),
+            cfg.param_dtype,
+        )
+        seg_emb = self.param(
+            "segment_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (cfg.n_segments, cfg.d_model),
+            cfg.param_dtype,
+        )
+        s = tokens.shape[1]
+        x = tok_emb.astype(cfg.dtype)[tokens]
+        x = x + pos_emb.astype(cfg.dtype)[None, :s]
+        if segments is not None:
+            x = x + seg_emb.astype(cfg.dtype)[segments]
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(x)
+        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+        # [CLS] pooling + tanh, classic BERT pooler.
+        pooled = _Dense(cfg.d_model, (EMBED, None), cfg, name="pooler")(x[:, 0])
+        return jnp.tanh(pooled)
+
+
+class BertClassifier(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        cfg = self.config
+        pooled = BertEncoder(cfg, name="encoder")(tokens, deterministic=deterministic)
+        logits = _Dense(cfg.num_classes, (EMBED, None), cfg, name="classifier")(pooled)
+        return logits.astype(jnp.float32)
+
+
+def make_experiment(
+    config: Optional[BertConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 100,
+    batch_size: int = 32,
+    seq_len: int = 128,
+    learning_rate: float = 2e-5,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    """Sequence-classification fine-tune (synthetic tokens unless input_fn
+    yields {"x": tokens, "y": labels})."""
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+
+    config = config or BertConfig.base()
+    model = BertClassifier(config)
+
+    def synthetic():
+        rng = np.random.RandomState(0)
+        while True:
+            tokens = rng.randint(0, config.vocab_size, (batch_size, seq_len))
+            labels = (tokens[:, 0] % config.num_classes).astype(np.int32)
+            yield {"x": tokens.astype(np.int32), "y": labels}
+
+    def loss_fn(model, params, batch, rng):
+        logits = model.apply(params, batch["x"], rngs={"dropout": rng},
+                             deterministic=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == batch["y"])
+        return loss, {"accuracy": accuracy}
+
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.adamw(learning_rate),
+        loss_fn=loss_fn,
+        train_input_fn=input_fn or synthetic,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: model.init(rng, batch["x"]),
+        mesh_spec=mesh_spec,
+    )

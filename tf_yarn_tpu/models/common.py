@@ -1,0 +1,67 @@
+"""Shared loss/metric builders for the model zoo.
+
+Loss contract (tf_yarn_tpu.experiment): ``loss_fn(model, params, batch,
+rng) -> (loss, aux)`` with batch a dict of arrays, labels under "y".
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def classification_loss(model, params, batch, rng):
+    """Softmax cross-entropy + accuracy for models mapping x -> logits."""
+    logits = model.apply(params, batch["x"], rngs={"dropout": rng})
+    labels = batch["y"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+    accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
+    return loss, {"accuracy": accuracy}
+
+
+def binary_logistic_loss(model, params, batch, rng):
+    """Sigmoid cross-entropy for models mapping x -> a single logit."""
+    logits = model.apply(params, batch["x"], rngs={"dropout": rng}).squeeze(-1)
+    labels = batch["y"].astype(jnp.float32)
+    loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+    accuracy = jnp.mean((logits > 0) == (labels > 0.5))
+    return loss, {"accuracy": accuracy}
+
+
+def lm_loss(model, params, batch, rng):
+    """Next-token cross-entropy for causal LMs: batch has "tokens"
+    [B, S] int32; loss over positions 0..S-2 predicting 1..S-1."""
+    tokens = batch["tokens"]
+    logits = model.apply(params, tokens, rngs={"dropout": rng})
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    if "mask" in batch:
+        mask = batch["mask"][:, 1:].astype(loss.dtype)
+        loss = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    else:
+        loss = loss.mean()
+    return loss, {"perplexity": jnp.exp(loss)}
+
+
+def synthetic_classification_iter(
+    batch_size: int, feature_dim: int, num_classes: int, seed: int = 0
+):
+    """Endless synthetic (x, y) batches — fixed shapes, deterministic."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    weights = rng.randn(feature_dim, num_classes).astype(np.float32)
+    while True:
+        x = rng.randn(batch_size, feature_dim).astype(np.float32)
+        y = np.argmax(x @ weights + 0.1 * rng.randn(batch_size, num_classes), axis=-1)
+        yield {"x": x, "y": y.astype(np.int32)}
+
+
+def synthetic_token_iter(batch_size: int, seq_len: int, vocab: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    while True:
+        yield {"tokens": rng.randint(0, vocab, (batch_size, seq_len), dtype=np.int32)}

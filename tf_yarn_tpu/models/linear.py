@@ -1,0 +1,102 @@
+"""Hashed linear classifier — BASELINE.json config 2 (the reference's
+`tf.estimator.LinearClassifier` on Criteo clicks, reference:
+examples/linear_classifier_example.py:33-79).
+
+Sparse logistic regression the TPU way: categorical features arrive as
+hashed bucket ids [B, n_features] int32; the weight table is an embedding
+of shape (n_buckets, 1) sharded over fsdp, gathered and summed on-device.
+No parameter servers — the table is mesh-sharded and updates ride ICI
+(the PS-strategy replacement, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearConfig:
+    n_buckets: int = 2**20
+    n_features: int = 39  # criteo clicks: 13 numeric + 26 categorical
+    n_dense: int = 0
+
+
+class HashedLinearClassifier(nn.Module):
+    """{"x": int32 [B, F] bucket ids, optional "dense": [B, D]} -> logit [B, 1]."""
+
+    config: LinearConfig
+
+    @nn.compact
+    def __call__(self, x, dense=None):
+        cfg = self.config
+        table = self.param(
+            "weights",
+            nn.with_partitioning(nn.initializers.zeros_init(), ("embed", None)),
+            (cfg.n_buckets, 1),
+            jnp.float32,
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (1,), jnp.float32)
+        logit = jnp.sum(jnp.squeeze(table[x], -1), axis=-1, keepdims=True) + bias
+        if dense is not None and cfg.n_dense:
+            dense_w = self.param(
+                "dense_weights", nn.initializers.zeros_init(), (cfg.n_dense, 1),
+                jnp.float32,
+            )
+            logit = logit + dense @ dense_w
+        return logit
+
+
+def hash_features(raw: "list[str] | object", n_buckets: int):
+    """Host-side feature hashing (the analog of TF's
+    categorical_column_with_hash_bucket)."""
+    import numpy as np
+
+    def bucket(value: str) -> int:
+        return hash(value) % n_buckets
+
+    return np.asarray([[bucket(v) for v in row] for row in raw], dtype=np.int32)
+
+
+def make_experiment(
+    config: Optional[LinearConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 200,
+    batch_size: int = 512,
+    learning_rate: float = 0.05,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+    from tf_yarn_tpu.models import common
+
+    config = config or LinearConfig()
+    model = HashedLinearClassifier(config)
+
+    def synthetic():
+        rng = np.random.RandomState(0)
+        hot = rng.randint(0, config.n_buckets, 64)  # a few predictive buckets
+        while True:
+            x = rng.randint(0, config.n_buckets, (batch_size, config.n_features))
+            y = np.isin(x, hot).sum(axis=1) > 0
+            yield {"x": x.astype(np.int32), "y": y.astype(np.int32)}
+
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.adagrad(learning_rate),  # FTRL-adjacent, sparse-friendly
+        loss_fn=common.binary_logistic_loss,
+        train_input_fn=input_fn or synthetic,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: model.init(rng, batch["x"]),
+        mesh_spec=mesh_spec,
+    )

@@ -1,0 +1,68 @@
+"""MNIST-style dense classifier — BASELINE.json config 1.
+
+The acceptance model for the minimum end-to-end slice (SURVEY.md §7.4):
+a plain flax MLP with *no* sharding annotations, exercising the
+unannotated-model path (FSDP inference / replication) of
+tf_yarn_tpu.parallel.sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import optax
+
+from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+from tf_yarn_tpu.models import common
+from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+
+class DenseClassifier(nn.Module):
+    hidden_sizes: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for size in self.hidden_sizes:
+            x = nn.relu(nn.Dense(size)(x))
+            if self.dropout_rate:
+                x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def make_experiment(
+    model_dir: Optional[str] = None,
+    train_steps: int = 200,
+    batch_size: int = 128,
+    feature_dim: int = 784,
+    num_classes: int = 10,
+    learning_rate: float = 1e-3,
+    mesh_spec: Optional[MeshSpec] = None,
+    input_fn=None,
+    eval_input_fn=None,
+    **train_param_overrides,
+) -> JaxExperiment:
+    model = DenseClassifier(num_classes=num_classes)
+    defaults = dict(
+        train_steps=train_steps,
+        log_every_steps=max(1, train_steps // 10),
+    )
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.adam(learning_rate),
+        loss_fn=common.classification_loss,
+        train_input_fn=input_fn
+        or (
+            lambda: common.synthetic_classification_iter(
+                batch_size, feature_dim, num_classes
+            )
+        ),
+        eval_input_fn=eval_input_fn,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        mesh_spec=mesh_spec,
+    )

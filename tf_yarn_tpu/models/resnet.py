@@ -1,0 +1,136 @@
+"""ResNet-50 — BASELINE.json config 4 (the reference's PyTorch CIFAR/
+ImageNet example family, reference: examples/pytorch/pytorch_example.py).
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU),
+bf16 compute, and GroupNorm instead of BatchNorm — GroupNorm carries no
+cross-step running statistics, so the train step stays a pure function
+(no mutable collections, no cross-replica stat sync) and compiles to one
+clean XLA program. Convs are MXU-bound just like matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    num_groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet50(cls, **overrides) -> "ResNetConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "ResNetConfig":
+        defaults = dict(stage_sizes=(1, 1), num_classes=10, width=8, num_groups=4)
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        norm = partial(nn.GroupNorm, num_groups=min(cfg.num_groups, self.filters),
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="norm1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 name="conv2")(y)
+        y = nn.relu(norm(name="norm2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(num_groups=min(cfg.num_groups, self.filters * 4), name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides), name="proj")(x)
+            residual = norm(num_groups=min(cfg.num_groups, self.filters * 4),
+                            name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """images [B, H, W, C] -> logits [B, num_classes]."""
+
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="stem")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(cfg.num_groups, cfg.width),
+                                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                 name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = Bottleneck(cfg.width * 2**stage, strides, cfg,
+                               name=f"stage{stage}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                     param_dtype=cfg.param_dtype, name="head")(x)
+        return x
+
+
+def make_experiment(
+    config: Optional[ResNetConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 100,
+    batch_size: int = 128,
+    image_size: int = 224,
+    learning_rate: float = 0.1,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+    from tf_yarn_tpu.models import common
+
+    config = config or ResNetConfig.resnet50()
+    model = ResNet(config)
+
+    def synthetic():
+        rng = np.random.RandomState(0)
+        while True:
+            yield {
+                "x": rng.randn(batch_size, image_size, image_size, 3).astype(
+                    np.float32
+                ),
+                "y": rng.randint(0, config.num_classes, batch_size).astype(np.int32),
+            }
+
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.sgd(learning_rate, momentum=0.9),
+        loss_fn=common.classification_loss,
+        train_input_fn=input_fn or synthetic,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: model.init(rng, batch["x"]),
+        mesh_spec=mesh_spec,
+    )

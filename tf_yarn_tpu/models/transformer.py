@@ -1,0 +1,337 @@
+"""Decoder-only transformer family (llama-style) — the flagship model.
+
+Covers BASELINE.json config 5 (Llama-3-8B LoRA fine-tune) and serves as
+the `__graft_entry__` flagship. Nothing like it exists in the reference —
+tf-yarn carries user models opaquely — so this is where the TPU-first
+design pays: megatron tensor-parallel sharding annotations, sequence
+(ring) attention seam, bf16 compute / f32 params, `lax.scan` over stacked
+layers + per-layer remat for compile time and HBM, and LoRA adapters with
+a frozen-base optimizer mask.
+
+Architecture: RMSNorm pre-norm, RoPE positions, GQA, SwiGLU MLP — the
+llama recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_yarn_tpu.ops.attention import attention
+
+# Logical axis names (mapped to mesh axes by parallel.sharding.LOGICAL_RULES).
+EMBED = "embed"
+HEADS = "heads"
+KV = "kv"
+MLP = "mlp"
+VOCAB = "vocab"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"  # xla | flash | ring
+    scan_layers: bool = True
+    remat: bool = True
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **overrides) -> "TransformerConfig":
+        return cls(
+            vocab_size=128256,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_ff=14336,
+            max_seq_len=8192,
+            rope_theta=500000.0,
+            **overrides,
+        )
+
+    @classmethod
+    def tiny(cls, **overrides) -> "TransformerConfig":
+        defaults = dict(
+            vocab_size=256,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            d_ff=128,
+            max_seq_len=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def _partitioned(names):
+    return lambda init: nn.with_partitioning(init, names)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,S,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rx1 = x1 * cos - x2 * sin
+    rx2 = x2 * cos + x1 * sin
+    out = jnp.stack([rx1, rx2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        scale = self.param(
+            "scale", _partitioned((None,))(nn.initializers.ones), (x.shape[-1],),
+            cfg.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + cfg.norm_eps
+        )
+        return (norm * scale.astype(jnp.float32)).astype(cfg.dtype)
+
+
+class LoraDense(nn.Module):
+    """Dense with optional LoRA adapter: y = x @ W + scale * (x @ A) @ B.
+
+    The base kernel carries logical names for TP; LoRA factors stay
+    replicated (they're tiny). `lora_` prefix lets the optimizer mask
+    freeze everything else (see `lora_label_tree`).
+    """
+
+    features: int
+    kernel_names: tuple
+    config: TransformerConfig
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        kernel = self.param(
+            "kernel",
+            _partitioned(self.kernel_names)(nn.initializers.lecun_normal()),
+            (x.shape[-1], self.features),
+            cfg.param_dtype,
+        )
+        y = jnp.einsum("...d,df->...f", x, kernel.astype(cfg.dtype))
+        if cfg.lora_rank > 0:
+            lora_a = self.param(
+                "lora_a",
+                nn.initializers.normal(stddev=0.02),
+                (x.shape[-1], cfg.lora_rank),
+                cfg.param_dtype,
+            )
+            lora_b = self.param(
+                "lora_b",
+                nn.initializers.zeros_init(),
+                (cfg.lora_rank, self.features),
+                cfg.param_dtype,
+            )
+            scale = cfg.lora_alpha / cfg.lora_rank
+            y = y + scale * jnp.einsum(
+                "...d,dr,rf->...f", x, lora_a.astype(cfg.dtype), lora_b.astype(cfg.dtype)
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), cfg.param_dtype
+            )
+            y = y + bias.astype(cfg.dtype)
+        return y
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        b, s, _ = x.shape
+        q = LoraDense(cfg.n_heads * cfg.head_dim, (EMBED, HEADS), cfg, name="wq")(x)
+        k = LoraDense(cfg.n_kv_heads * cfg.head_dim, (EMBED, KV), cfg, name="wk")(x)
+        v = LoraDense(cfg.n_kv_heads * cfg.head_dim, (EMBED, KV), cfg, name="wv")(x)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        out = attention(q, k, v, impl=cfg.attention_impl, causal=True)
+        out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        return LoraDense(cfg.d_model, (HEADS, EMBED), cfg, name="wo")(out)
+
+
+class SwiGLU(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = LoraDense(cfg.d_ff, (EMBED, MLP), cfg, name="w_gate")(x)
+        up = LoraDense(cfg.d_ff, (EMBED, MLP), cfg, name="w_up")(x)
+        return LoraDense(cfg.d_model, (MLP, EMBED), cfg, name="w_down")(
+            nn.silu(gate) * up
+        )
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(RMSNorm(cfg, name="attn_norm")(x), positions)
+        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(x))
+        return x
+
+
+class _ScanBody(nn.Module):
+    """Scan adapter: gives Block the (carry, out) protocol nn.scan wants,
+    with remat applied per layer (activation memory ~ O(sqrt) instead of
+    O(n_layers) — the HBM/FLOPs trade SURVEY's TPU notes call for)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        block_cls = (
+            nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            )
+            if self.config.remat
+            else Block
+        )
+        return block_cls(self.config, name="block")(x, positions), None
+
+
+class Transformer(nn.Module):
+    """tokens [B, S] int32 -> logits [B, S, vocab]."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.config
+        embedding = self.param(
+            "embedding",
+            _partitioned((VOCAB, EMBED))(nn.initializers.normal(stddev=0.02)),
+            (cfg.vocab_size, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = embedding.astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+        )
+
+        if cfg.scan_layers:
+            scanned = nn.scan(
+                _ScanBody,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )
+            x, _ = scanned(cfg, name="layers")(x, positions)
+        else:
+            for i in range(cfg.n_layers):
+                x = _ScanBody(cfg, name=f"layer_{i}")(x, positions)[0]
+
+        x = RMSNorm(cfg, name="final_norm")(x)
+        head = self.param(
+            "lm_head",
+            _partitioned((EMBED, VOCAB))(nn.initializers.normal(stddev=0.02)),
+            (cfg.d_model, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+        return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def lora_label_tree(params) -> Any:
+    """Label pytree for optax.multi_transform: "lora" for adapter params,
+    "frozen" for the base model — the LoRA fine-tune recipe."""
+    import jax.tree_util as jtu
+
+    flat, treedef = jtu.tree_flatten_with_path(params)
+
+    def label(path) -> str:
+        names = (str(getattr(k, "key", getattr(k, "name", ""))) for k in path)
+        return "lora" if any(n.startswith("lora_") for n in names) else "frozen"
+
+    return jtu.tree_unflatten(treedef, [label(path) for path, _ in flat])
+
+
+def make_lora_optimizer(learning_rate: float = 1e-4):
+    """adamw on LoRA params, frozen base (reference has no analog — LoRA is
+    a BASELINE.json config 5 requirement)."""
+    import optax
+
+    return optax.multi_transform(
+        {"lora": optax.adamw(learning_rate), "frozen": optax.set_to_zero()},
+        lora_label_tree,
+    )
+
+
+def make_experiment(
+    config: Optional[TransformerConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 100,
+    batch_size: int = 8,
+    seq_len: Optional[int] = None,
+    learning_rate: float = 3e-4,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    """Causal-LM experiment (synthetic tokens unless input_fn given); LoRA
+    configs (config.lora_rank > 0) get the frozen-base optimizer."""
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+    from tf_yarn_tpu.models import common
+
+    config = config or TransformerConfig.tiny()
+    seq_len = seq_len or config.max_seq_len
+    optimizer = (
+        make_lora_optimizer(learning_rate)
+        if config.lora_rank > 0
+        else optax.adamw(learning_rate)
+    )
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=Transformer(config),
+        optimizer=optimizer,
+        loss_fn=common.lm_loss,
+        train_input_fn=input_fn
+        or (lambda: common.synthetic_token_iter(batch_size, seq_len, config.vocab_size)),
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: Transformer(config).init(rng, batch["tokens"]),
+        mesh_spec=mesh_spec,
+    )

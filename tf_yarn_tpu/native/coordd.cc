@@ -1,0 +1,469 @@
+// coordd — native coordination service (KV store + blocking waits + event log).
+//
+// The C++ replacement for the role the skein ApplicationMaster (Java+gRPC)
+// plays in the reference (SURVEY.md §2.4: control plane — KV pub/sub, app
+// lifecycle; reference usage tf_yarn/event.py:13-79, client.py:633-657).
+// Speaks exactly the wire protocol of the Python KVServer
+// (tf_yarn_tpu/coordination/kv.py): 4-byte big-endian length frames of
+// JSON; ops put/get/wait/events/keys/incr/del/ping/shutdown. The Python
+// KVClient treats the two servers as drop-in replacements; the driver
+// prefers this binary when built (coordination/server_factory.py).
+//
+// Build: make -C tf_yarn_tpu/native       (g++ -O2 -pthread, no deps)
+// Run:   coordd <host> <port>
+//
+// Concurrency model: one thread per connection (control-plane traffic is
+// sparse — tens of clients, few requests/sec), one global mutex + condvar
+// guarding the store; blocking waits sleep on the condvar, so a wait costs
+// no CPU and wakes exactly when a put lands.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON for this protocol: flat objects with string / double / null
+// values on requests; replies additionally need arrays. Full escape handling
+// for the string subset Python's json.dumps (ensure_ascii=True) emits.
+// ---------------------------------------------------------------------------
+
+struct JsonValue {
+  enum class Kind { Null, Str, Num, Bool } kind = Kind::Null;
+  std::string str;
+  double num = 0.0;
+  bool boolean = false;
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& text) : text_(text) {}
+
+  // Parse one flat object {"k": v, ...}; nested containers rejected.
+  bool ParseObject(std::map<std::string, JsonValue>* out) {
+    SkipWs();
+    if (!Consume('{')) return false;
+    SkipWs();
+    if (Consume('}')) return true;
+    while (true) {
+      SkipWs();
+      std::string key;
+      if (!ParseString(&key)) return false;
+      SkipWs();
+      if (!Consume(':')) return false;
+      SkipWs();
+      JsonValue value;
+      if (!ParseValue(&value)) return false;
+      (*out)[key] = std::move(value);
+      SkipWs();
+      if (Consume(',')) continue;
+      return Consume('}');
+    }
+  }
+
+ private:
+  void SkipWs() {
+    while (pos_ < text_.size() && std::isspace(static_cast<unsigned char>(text_[pos_]))) pos_++;
+  }
+  bool Consume(char c) {
+    if (pos_ < text_.size() && text_[pos_] == c) { pos_++; return true; }
+    return false;
+  }
+  bool ParseValue(JsonValue* out) {
+    if (pos_ >= text_.size()) return false;
+    char c = text_[pos_];
+    if (c == '"') { out->kind = JsonValue::Kind::Str; return ParseString(&out->str); }
+    if (c == 'n') { pos_ += 4; out->kind = JsonValue::Kind::Null; return true; }
+    if (c == 't') { pos_ += 4; out->kind = JsonValue::Kind::Bool; out->boolean = true; return true; }
+    if (c == 'f') { pos_ += 5; out->kind = JsonValue::Kind::Bool; out->boolean = false; return true; }
+    // number
+    size_t start = pos_;
+    while (pos_ < text_.size() &&
+           (std::isdigit(static_cast<unsigned char>(text_[pos_])) || text_[pos_] == '-' ||
+            text_[pos_] == '+' || text_[pos_] == '.' || text_[pos_] == 'e' || text_[pos_] == 'E'))
+      pos_++;
+    if (pos_ == start) return false;
+    out->kind = JsonValue::Kind::Num;
+    out->num = std::stod(text_.substr(start, pos_ - start));
+    return true;
+  }
+  bool ParseString(std::string* out) {
+    if (!Consume('"')) return false;
+    out->clear();
+    while (pos_ < text_.size()) {
+      char c = text_[pos_++];
+      if (c == '"') return true;
+      if (c != '\\') { out->push_back(c); continue; }
+      if (pos_ >= text_.size()) return false;
+      char esc = text_[pos_++];
+      switch (esc) {
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case 'n': out->push_back('\n'); break;
+        case 'r': out->push_back('\r'); break;
+        case 't': out->push_back('\t'); break;
+        case 'u': {
+          if (pos_ + 4 > text_.size()) return false;
+          unsigned code = std::stoul(text_.substr(pos_, 4), nullptr, 16);
+          pos_ += 4;
+          // Surrogate pair (python escapes astral chars this way).
+          if (code >= 0xD800 && code <= 0xDBFF && pos_ + 6 <= text_.size() &&
+              text_[pos_] == '\\' && text_[pos_ + 1] == 'u') {
+            unsigned low = std::stoul(text_.substr(pos_ + 2, 4), nullptr, 16);
+            pos_ += 6;
+            code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+          }
+          // UTF-8 encode.
+          if (code < 0x80) {
+            out->push_back(static_cast<char>(code));
+          } else if (code < 0x800) {
+            out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+            out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+          } else if (code < 0x10000) {
+            out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+            out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+          } else {
+            out->push_back(static_cast<char>(0xF0 | (code >> 18)));
+            out->push_back(static_cast<char>(0x80 | ((code >> 12) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+          }
+          break;
+        }
+        default: return false;
+      }
+    }
+    return false;
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+std::string JsonEscape(const std::string& in) {
+  std::string out;
+  out.reserve(in.size() + 8);
+  for (unsigned char c : in) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));  // raw UTF-8 passes through
+        }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// base64 (values travel base64-encoded; incr must read/write real numbers)
+// ---------------------------------------------------------------------------
+
+const char kB64Chars[] = "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string B64Encode(const std::string& in) {
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  for (size_t i = 0; i < in.size(); i += 3) {
+    uint32_t chunk = static_cast<unsigned char>(in[i]) << 16;
+    if (i + 1 < in.size()) chunk |= static_cast<unsigned char>(in[i + 1]) << 8;
+    if (i + 2 < in.size()) chunk |= static_cast<unsigned char>(in[i + 2]);
+    out.push_back(kB64Chars[(chunk >> 18) & 0x3F]);
+    out.push_back(kB64Chars[(chunk >> 12) & 0x3F]);
+    out.push_back(i + 1 < in.size() ? kB64Chars[(chunk >> 6) & 0x3F] : '=');
+    out.push_back(i + 2 < in.size() ? kB64Chars[chunk & 0x3F] : '=');
+  }
+  return out;
+}
+
+std::string B64Decode(const std::string& in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  std::string out;
+  int buffer = 0, bits = 0;
+  for (char c : in) {
+    int v = val(c);
+    if (v < 0) continue;  // '=' padding / whitespace
+    buffer = (buffer << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out.push_back(static_cast<char>((buffer >> bits) & 0xFF));
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Store
+// ---------------------------------------------------------------------------
+
+class Store {
+ public:
+  void Put(const std::string& key, std::string value) {
+    std::lock_guard<std::mutex> lock(mu_);
+    data_[key] = std::move(value);
+    log_.push_back(key);
+    cv_.notify_all();
+  }
+
+  std::optional<std::string> Get(const std::string& key) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = data_.find(key);
+    if (it == data_.end()) return std::nullopt;
+    return it->second;
+  }
+
+  // Blocks until key exists; timeout_s < 0 means wait forever.
+  std::optional<std::string> Wait(const std::string& key, double timeout_s) {
+    std::unique_lock<std::mutex> lock(mu_);
+    auto pred = [&] { return data_.count(key) > 0; };
+    if (timeout_s < 0) {
+      cv_.wait(lock, pred);
+    } else if (!cv_.wait_for(lock, std::chrono::duration<double>(timeout_s), pred)) {
+      return std::nullopt;
+    }
+    return data_[key];
+  }
+
+  std::vector<std::pair<size_t, std::string>> Events(size_t since, size_t* next) {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::vector<std::pair<size_t, std::string>> out;
+    for (size_t i = since; i < log_.size(); ++i) out.emplace_back(i, log_[i]);
+    *next = log_.size();
+    return out;
+  }
+
+  std::vector<std::string> Keys(const std::string& prefix) {
+    std::lock_guard<std::mutex> lock(mu_);
+    std::vector<std::string> out;
+    for (const auto& [key, _] : data_)
+      if (key.rfind(prefix, 0) == 0) out.push_back(key);
+    return out;  // std::map iterates sorted
+  }
+
+  // Values are stored as the base64 text the protocol carries; incr
+  // decodes the decimal inside, bumps it, re-encodes.
+  long long Incr(const std::string& key, long long amount) {
+    std::lock_guard<std::mutex> lock(mu_);
+    long long current = 0;
+    auto it = data_.find(key);
+    if (it != data_.end()) current = std::stoll(B64Decode(it->second));
+    current += amount;
+    data_[key] = B64Encode(std::to_string(current));
+    log_.push_back(key);
+    cv_.notify_all();
+    return current;
+  }
+
+  void Del(const std::string& key) {
+    std::lock_guard<std::mutex> lock(mu_);
+    data_.erase(key);
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::vector<std::string> log_;
+};
+
+// ---------------------------------------------------------------------------
+// Framing + request handling
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kMaxFrame = 64u * 1024 * 1024;
+
+bool RecvExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool SendFrame(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  char header[4];
+  std::memcpy(header, &len, 4);
+  std::string framed(header, 4);
+  framed += payload;
+  size_t sent = 0;
+  while (sent < framed.size()) {
+    ssize_t r = ::send(fd, framed.data() + sent, framed.size() - sent, 0);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+std::string GetStr(const std::map<std::string, JsonValue>& obj, const std::string& key) {
+  auto it = obj.find(key);
+  return (it != obj.end() && it->second.kind == JsonValue::Kind::Str) ? it->second.str : "";
+}
+
+std::atomic<bool> g_shutdown{false};
+
+std::string Handle(Store& store, const std::map<std::string, JsonValue>& req) {
+  const std::string op = GetStr(req, "op");
+  if (op == "put") {
+    store.Put(GetStr(req, "key"), GetStr(req, "value"));
+    return R"({"ok":true})";
+  }
+  if (op == "get") {
+    auto value = store.Get(GetStr(req, "key"));
+    if (!value) return R"({"ok":true,"value":null})";
+    return std::string(R"({"ok":true,"value":")") + JsonEscape(*value) + "\"}";
+  }
+  if (op == "wait") {
+    double timeout = -1.0;
+    auto it = req.find("timeout");
+    if (it != req.end() && it->second.kind == JsonValue::Kind::Num) timeout = it->second.num;
+    auto value = store.Wait(GetStr(req, "key"), timeout);
+    if (!value)
+      return std::string(R"({"ok":false,"timeout":true,"error":"timed out waiting for )") +
+             JsonEscape(GetStr(req, "key")) + "\"}";
+    return std::string(R"({"ok":true,"value":")") + JsonEscape(*value) + "\"}";
+  }
+  if (op == "events") {
+    size_t since = 0;
+    auto it = req.find("since");
+    if (it != req.end() && it->second.kind == JsonValue::Kind::Num)
+      since = static_cast<size_t>(it->second.num);
+    size_t next = 0;
+    auto events = store.Events(since, &next);
+    std::string out = R"({"ok":true,"events":[)";
+    for (size_t i = 0; i < events.size(); ++i) {
+      if (i) out += ",";
+      out += "[" + std::to_string(events[i].first) + ",\"" + JsonEscape(events[i].second) + "\"]";
+    }
+    out += "],\"next\":" + std::to_string(next) + "}";
+    return out;
+  }
+  if (op == "keys") {
+    auto keys = store.Keys(GetStr(req, "prefix"));
+    std::string out = R"({"ok":true,"keys":[)";
+    for (size_t i = 0; i < keys.size(); ++i) {
+      if (i) out += ",";
+      out += "\"" + JsonEscape(keys[i]) + "\"";
+    }
+    out += "]}";
+    return out;
+  }
+  if (op == "incr") {
+    long long amount = 1;
+    auto it = req.find("amount");
+    if (it != req.end() && it->second.kind == JsonValue::Kind::Num)
+      amount = static_cast<long long>(it->second.num);
+    return R"({"ok":true,"value":)" + std::to_string(store.Incr(GetStr(req, "key"), amount)) + "}";
+  }
+  if (op == "del") {
+    store.Del(GetStr(req, "key"));
+    return R"({"ok":true})";
+  }
+  if (op == "ping") return R"({"ok":true,"server":"coordd"})";
+  if (op == "shutdown") {
+    g_shutdown = true;
+    return R"({"ok":true})";
+  }
+  return R"({"ok":false,"error":"unknown op"})";
+}
+
+void ServeConnection(Store* store, int fd) {
+  while (!g_shutdown) {
+    char header[4];
+    if (!RecvExact(fd, header, 4)) break;
+    uint32_t len;
+    std::memcpy(&len, header, 4);
+    len = ntohl(len);
+    if (len > kMaxFrame) break;
+    std::string payload(len, '\0');
+    if (!RecvExact(fd, payload.data(), len)) break;
+    std::map<std::string, JsonValue> req;
+    JsonParser parser(payload);
+    std::string reply;
+    if (!parser.ParseObject(&req)) {
+      reply = R"({"ok":false,"error":"bad json"})";
+    } else {
+      reply = Handle(*store, req);
+    }
+    if (!SendFrame(fd, reply)) break;
+    if (g_shutdown) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? std::atoi(argv[2]) : 0;
+
+  int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) { std::perror("socket"); return 1; }
+  int one = 1;
+  ::setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) { std::fprintf(stderr, "bad host\n"); return 1; }
+  if (::bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (::listen(listener, 128) != 0) { std::perror("listen"); return 1; }
+  socklen_t addr_len = sizeof(addr);
+  ::getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &addr_len);
+  std::printf("coordd listening on %s:%d\n", host, ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  Store store;
+  while (!g_shutdown) {
+    // Accept with a poll-ish timeout so shutdown can take effect.
+    timeval tv{0, 200000};
+    fd_set fds;
+    FD_ZERO(&fds);
+    FD_SET(listener, &fds);
+    int ready = ::select(listener + 1, &fds, nullptr, nullptr, &tv);
+    if (ready <= 0) continue;
+    int fd = ::accept(listener, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(ServeConnection, &store, fd).detach();
+  }
+  ::close(listener);
+  return 0;
+}

@@ -1,0 +1,72 @@
+"""Attention implementations and the dispatch seam.
+
+The hot op of the transformer family. Three interchangeable backends, all
+the same signature — [B, S, H, D] q, [B, S_kv, H_kv, D] k/v, GQA via
+H_kv <= H — selected by `TransformerConfig.attention_impl`:
+
+* ``"xla"``   — einsum + softmax; XLA fuses it well on the MXU and it runs
+  everywhere (CPU test rig included). The correctness reference.
+* ``"flash"`` — pallas blockwise-softmax kernel (tf_yarn_tpu/ops/
+  flash_attention.py), HBM-friendly for long sequences on TPU.
+* ``"ring"``  — sequence-parallel ring attention over the `sp` mesh axis
+  (tf_yarn_tpu/parallel/ring_attention.py) for sequences longer than one
+  chip's HBM can hold.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(key: jax.Array, value: jax.Array, n_rep: int):
+    if n_rep == 1:
+        return key, value
+    key = jnp.repeat(key, n_rep, axis=2)
+    value = jnp.repeat(value, n_rep, axis=2)
+    return key, value
+
+
+def xla_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    *,
+    causal: bool = True,
+    softmax_scale: float | None = None,
+    segment_offset: int = 0,
+) -> jax.Array:
+    """Reference attention: q [B,S,H,D], k/v [B,Skv,Hkv,D] -> [B,S,H,D].
+
+    `segment_offset` shifts the causal mask for sequence-sharded callers
+    (ring attention evaluates blocks whose global positions start there).
+    Softmax runs in f32 regardless of input dtype — the bf16-safe pattern.
+    """
+    b, s_q, n_heads, head_dim = query.shape
+    _, s_kv, n_kv, _ = key.shape
+    key, value = _repeat_kv(key, value, n_heads // n_kv)
+    scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", query, key) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        q_pos = jnp.arange(s_q)[:, None] + segment_offset
+        k_pos = jnp.arange(s_kv)[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+
+
+def attention(query, key, value, *, impl: str = "xla", causal: bool = True):
+    """Dispatch to the configured backend."""
+    if impl == "flash":
+        from tf_yarn_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(query, key, value, causal=causal)
+    if impl == "ring":
+        from tf_yarn_tpu.parallel.ring_attention import ring_attention_sharded
+
+        return ring_attention_sharded(query, key, value, causal=causal)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}; use xla | flash | ring")
+    return xla_attention(query, key, value, causal=causal)

@@ -1,0 +1,25 @@
+from tf_yarn_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    select_devices,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_EP",
+    "AXIS_FSDP",
+    "AXIS_PP",
+    "AXIS_SP",
+    "AXIS_TP",
+    "MeshSpec",
+    "batch_sharding",
+    "build_mesh",
+    "select_devices",
+]

@@ -1,0 +1,157 @@
+"""Device mesh: the TPU-native replacement for cluster process topologies.
+
+The reference's parallelism is process-shaped (PS tasks, Horovod rings,
+DDP ranks — SURVEY.md §2.5); on TPU parallelism is *mesh-shaped*: a named
+`jax.sharding.Mesh` over the slice's chips, with XLA inserting collectives
+over ICI wherever shardings demand it. One MeshSpec covers every strategy
+the reference ships (data parallelism in its three guises) plus the ones it
+lacks (FSDP/ZeRO, tensor, sequence/context, expert, pipeline) — strategies
+become axis assignments, not separate code paths.
+
+Axes (any may be 1, i.e. disabled):
+
+* ``dp``   — pure data parallelism: params replicated, batch sharded.
+* ``fsdp`` — data parallelism with params/optimizer sharded (ZeRO-3).
+* ``tp``   — tensor parallelism (megatron-style row/col sharding).
+* ``sp``   — sequence/context parallelism (ring attention over this axis).
+* ``ep``   — expert parallelism for MoE layers.
+* ``pp``   — pipeline stages.
+
+Mesh axis order is (pp, dp, fsdp, sp, tp, ep): the fastest-varying axes
+(tp/ep) map to directly-wired ICI neighbors, which is where the
+bandwidth-hungry collectives live.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+AXIS_PP = "pp"
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+
+# Batch dimension shards over every data-like axis.
+BATCH_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Parallelism layout for a run; crosses driver → tasks via the KV store
+    (constants.KV_MESH_SPEC)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_SP, AXIS_TP, AXIS_EP)
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.pp, self.dp, self.fsdp, self.sp, self.tp, self.ep)
+
+    @property
+    def total_devices(self) -> int:
+        return math.prod(self.axis_sizes)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "MeshSpec":
+        return cls(**json.loads(raw))
+
+    @classmethod
+    def auto(cls, n_devices: int) -> "MeshSpec":
+        """Default layout: all devices on the fsdp axis — synchronous DP
+        with sharded optimizer state, the TPU answer to all three of the
+        reference's DP modes (SURVEY.md §2.5)."""
+        return cls(fsdp=n_devices)
+
+
+def select_devices(n: Optional[int] = None, platform: Optional[str] = None):
+    """Devices for the mesh. `TPU_YARN_PLATFORM=cpu` (or the `platform`
+    arg) forces the virtual CPU platform — the multi-device test rig."""
+    import jax
+
+    platform = platform or os.environ.get("TPU_YARN_PLATFORM")
+    n_virtual = os.environ.get("TPU_YARN_VIRTUAL_DEVICES")
+    if n_virtual and "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # Must land before the CPU backend initializes in this process;
+        # crossing the driver→task boundary via env is the supported way to
+        # get a multi-device CPU rig in task subprocesses.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_virtual}"
+        )
+    if platform:
+        # Narrow backend init to the requested platform. Plain
+        # `jax.devices(platform)` initializes *every* registered plugin
+        # first; under the axon image that dials the TPU relay even for
+        # CPU-only work (and hangs when the relay is unavailable).
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:  # pragma: no cover - late update after init
+            pass
+    devices = jax.devices(platform) if platform else jax.devices()
+    if n is not None:
+        if len(devices) < n:
+            raise ValueError(
+                f"need {n} devices, have {len(devices)} ({platform or 'default'})"
+            )
+        devices = devices[:n]
+    return devices
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build the named Mesh for `spec` (row-major device assignment)."""
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = select_devices(spec.total_devices)
+    if len(devices) != spec.total_devices:
+        raise ValueError(
+            f"MeshSpec wants {spec.total_devices} devices "
+            f"({dict(zip(spec.axis_names, spec.axis_sizes))}), got {len(devices)}"
+        )
+    mesh_devices = np.asarray(devices).reshape(spec.axis_sizes)
+    return Mesh(mesh_devices, spec.axis_names)
+
+
+def batch_sharding(mesh, extra_batch_dims: int = 0):
+    """NamedSharding for a [global_batch, ...] input: batch over dp+fsdp,
+    remaining dims replicated (sequence sharding is applied inside models
+    via logical rules, not on input placement)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(BATCH_AXES, *([None] * extra_batch_dims)))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()

@@ -1,0 +1,127 @@
+"""Parameter/activation sharding rules: logical axes → mesh axes.
+
+The reference has no model sharding at all (SURVEY.md §2.5 — PS-sharding
+of variables is TF-internal); here sharding is the core abstraction.
+Models annotate parameters with *logical* axis names (flax
+`nn.with_partitioning`, e.g. ("embed", "mlp")); these rules map logical
+names onto the physical mesh axes of `tf_yarn_tpu.parallel.mesh.MeshSpec`.
+
+Two paths:
+
+* Annotated models (the transformer family in tf_yarn_tpu/models/): exact
+  megatron-style placement via `LOGICAL_RULES`.
+* Unannotated models (any flax module): `infer_fsdp_partition` shards the
+  largest divisible axis of every ≥2D param over the fsdp axis — ZeRO-3
+  semantics with zero model changes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tf_yarn_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_FSDP,
+    AXIS_SP,
+    AXIS_TP,
+    BATCH_AXES,
+)
+
+_logger = logging.getLogger(__name__)
+
+# Logical-axis → mesh-axis rules (first matching entry wins; None = replicate).
+# Megatron placement: attention heads + MLP hidden over tp; embed/residual
+# dims over fsdp (ZeRO); batch over dp+fsdp; sequence over sp.
+LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("batch", BATCH_AXES),
+    ("seq", AXIS_SP),
+    ("embed", AXIS_FSDP),
+    ("heads", AXIS_TP),
+    ("kv", None),
+    ("mlp", AXIS_TP),
+    ("vocab", AXIS_TP),
+    ("expert", AXIS_EP),
+    ("conv_out", AXIS_FSDP),
+    ("stage", None),
+)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[Optional[str]], rules=LOGICAL_RULES
+) -> PartitionSpec:
+    mapping = dict(rules)
+    return PartitionSpec(
+        *(mapping.get(name) if name is not None else None for name in logical_axes)
+    )
+
+
+def _divisible_axis(shape: Tuple[int, ...], size: int) -> Optional[int]:
+    """Largest axis divisible by `size` (prefer later axes on ties — output
+    dims, which avoids shards crossing the reduction dim of matmuls)."""
+    best = None
+    best_dim = 0
+    for index, dim in enumerate(shape):
+        if dim % size == 0 and dim >= best_dim:
+            best = index
+            best_dim = dim
+    return best
+
+
+def infer_fsdp_partition(shape: Tuple[int, ...], fsdp_size: int) -> PartitionSpec:
+    """ZeRO-style sharding for an unannotated param: shard one axis over
+    fsdp if any axis divides, else replicate. Scalars/1D stay replicated
+    (they're tiny; sharding them buys nothing and breaks odd sizes)."""
+    if fsdp_size <= 1 or len(shape) < 2:
+        return PartitionSpec()
+    axis = _divisible_axis(shape, fsdp_size)
+    if axis is None:
+        return PartitionSpec()
+    spec = [None] * len(shape)
+    spec[axis] = AXIS_FSDP
+    return PartitionSpec(*spec)
+
+
+def _leaf_spec(leaf, fsdp_size: int) -> PartitionSpec:
+    # flax `nn.with_partitioning` wraps leaves in nn.Partitioned with .names.
+    names = getattr(leaf, "names", None)
+    if names is not None:
+        return logical_to_spec(names)
+    shape = getattr(leaf, "shape", ())
+    return infer_fsdp_partition(tuple(shape), fsdp_size)
+
+
+def _is_leaf(node) -> bool:
+    return hasattr(node, "names") and hasattr(node, "value")
+
+
+def tree_partition_specs(tree, fsdp_size: int):
+    """PartitionSpec pytree matching `tree` (params, opt state, or a whole
+    TrainState); annotated leaves follow LOGICAL_RULES, the rest FSDP-infer."""
+    return jax.tree_util.tree_map(
+        lambda leaf: _leaf_spec(leaf, fsdp_size), tree, is_leaf=_is_leaf
+    )
+
+
+def tree_shardings(mesh: Mesh, tree, fsdp_size: Optional[int] = None):
+    """NamedSharding pytree for placing `tree` on `mesh`."""
+    if fsdp_size is None:
+        fsdp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_FSDP, 1)
+    specs = tree_partition_specs(tree, fsdp_size)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda node: isinstance(node, PartitionSpec),
+    )
+
+
+def unbox_params(tree):
+    """Strip flax Partitioned boxes, leaving raw arrays (used after placement
+    decisions are extracted, so apply() sees plain params)."""
+    import flax.linen as nn
+
+    return nn.meta.unbox(tree)

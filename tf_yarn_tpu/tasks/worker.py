@@ -24,10 +24,44 @@ from tf_yarn_tpu.tasks import _bootstrap
 _logger = logging.getLogger(__name__)
 
 
+def _maybe_init_jax_distributed(runtime: _bootstrap.TaskRuntime) -> None:
+    """Multi-host JAX bootstrap. Must run before anything touches devices —
+    the ordering constraint SURVEY.md §7 ranks as hard part 3 (the analog of
+    TF_CONFIG-before-Estimator, _independent_workers_task.py:22-24). The
+    coordinator is our KV-elected master (reference choose_master,
+    _task_commons.py:95-108) — jax.distributed's coordinator replaces
+    nothing here: the KV service stays the control plane, this only wires
+    process discovery for multi-host XLA."""
+    import os
+
+    primaries = sorted(
+        (ti for ti in runtime.cluster_tasks if ti.key.type in ("chief", "worker")),
+        key=lambda ti: (0 if ti.key.type == "chief" else 1, ti.key.id),
+    )
+    if len(primaries) <= 1 or os.environ.get("TPU_YARN_NO_JAX_DIST"):
+        return
+    if any(ti.nb_proc != 1 for ti in primaries):
+        raise ValueError(
+            "JAX experiments need nb_proc_per_worker=1 (one JAX process "
+            "drives all local chips); use tasks.distributed for "
+            "multi-process-per-host jobs"
+        )
+    addr = _task_commons.choose_master(runtime.kv, runtime.task_key, runtime.cluster_tasks)
+    process_id = [ti.key for ti in primaries].index(runtime.task_key)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=len(primaries),
+        process_id=process_id,
+    )
+
+
 def _run_experiment(runtime: _bootstrap.TaskRuntime, experiment) -> None:
     from tf_yarn_tpu import experiment as experiment_mod
 
     if isinstance(experiment, experiment_mod.EXPERIMENT_TYPES):
+        _maybe_init_jax_distributed(runtime)
         experiment_mod.run_experiment(runtime, experiment)
     elif callable(experiment):
         experiment()

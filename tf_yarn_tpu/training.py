@@ -1,0 +1,292 @@
+"""The pjit train-and-evaluate loop — the framework's data plane.
+
+This replaces *all three* of the reference's training data planes
+(SURVEY.md §2.5): ParameterServerStrategy gRPC (tensorflow/cluster.py:
+53-67), Horovod/Gloo rings (gloo_allred_task.py), and DDP/NCCL
+(pytorch/tasks/worker.py) — with one compiled XLA program over a named
+device mesh. Gradients never leave the step function: the sharded loss →
+grad → update chain is jitted once, and XLA inserts the ICI collectives
+(allreduce over dp, reduce-scatter/all-gather over fsdp, etc.) that the
+shardings imply.
+
+TPU-first design points:
+* Everything hot is inside one `jax.jit` with donated state (no
+  host↔device ping-pong per step; HBM re-use for the optimizer update).
+* Static shapes: the input pipeline must yield fixed-shape batches
+  (drop-last semantics; the compile-shape hazard the reference only
+  warns about, pytorch/experiment.py:10-15, is enforced here).
+* Batches land sharded via `jax.make_array_from_process_local_data`, so
+  the same loop serves single-process and multi-host runs.
+* bfloat16 matmuls are the model's concern (the zoo defaults to bf16
+  compute / f32 params); the loop is dtype-agnostic.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import numpy as np
+import optax
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+from tf_yarn_tpu import event
+from tf_yarn_tpu.experiment import CoreExperiment
+from tf_yarn_tpu.parallel import mesh as mesh_lib
+from tf_yarn_tpu.parallel import sharding as sharding_lib
+from tf_yarn_tpu.utils import mlflow
+
+_logger = logging.getLogger(__name__)
+
+
+class TrainState(NamedTuple):
+    """Minimal train state; a plain pytree so sharding specs apply leaf-wise."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _default_init_fn(model):
+    def init_fn(rng, batch):
+        features = {k: v for k, v in batch.items() if k != "y"}
+        if len(features) == 1:
+            return model.init(rng, next(iter(features.values())))
+        return model.init(rng, **features)
+
+    return init_fn
+
+
+def _named_shardings(mesh, abstract_tree):
+    return sharding_lib.tree_shardings(mesh, abstract_tree)
+
+
+def make_batch_globalizer(mesh):
+    """Return fn placing a host-local numpy batch as a global sharded array.
+
+    In multi-host runs each process feeds its local slice of the global
+    batch; single-process runs feed the whole thing. `
+    make_array_from_process_local_data` handles both layouts.
+    """
+    shardings_by_ndim: Dict[int, jax.sharding.NamedSharding] = {}
+
+    def globalize(batch: Dict[str, np.ndarray]):
+        out = {}
+        for key, value in batch.items():
+            value = np.asarray(value)
+            shard = shardings_by_ndim.get(value.ndim)
+            if shard is None:
+                shard = mesh_lib.batch_sharding(mesh, extra_batch_dims=value.ndim - 1)
+                shardings_by_ndim[value.ndim] = shard
+            out[key] = jax.make_array_from_process_local_data(shard, value)
+        return out
+
+    return globalize
+
+
+def build_train_step(model, loss_fn, optimizer):
+    def train_step(state: TrainState, batch, base_rng):
+        rng = jax.random.fold_in(base_rng, state.step)
+        grad_fn = jax.value_and_grad(
+            lambda params: loss_fn(model, params, batch, rng), has_aux=True
+        )
+        (loss, aux), grads = grad_fn(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    return train_step
+
+
+def build_eval_step(model, loss_fn):
+    def eval_step(state: TrainState, batch, base_rng):
+        loss, aux = loss_fn(model, state.params, batch, base_rng)
+        return {"loss": loss, **aux}
+
+    return eval_step
+
+
+class _StepsPerSecondHook:
+    """Chief-only steps/sec reporting (reference StepPerSecondHook,
+    tensorflow/metrics.py:18-38): KV broadcast + MLflow + log."""
+
+    def __init__(self, runtime, every: int, n_try: int = 0) -> None:
+        self._runtime = runtime
+        self._every = max(1, every)
+        self._n_try = n_try
+        self._t0 = time.time()
+        self._step0 = 0
+
+    def after_step(self, step: int, metrics: Dict[str, Any], force: bool = False) -> None:
+        if step % self._every != 0 and not force:
+            return
+        now = time.time()
+        steps_per_sec = (step - self._step0) / max(now - self._t0, 1e-9)
+        self._t0, self._step0 = now, step
+        loss = metrics.get("loss")
+        _logger.info("step %d: loss=%s steps/sec=%.3f", step, loss, steps_per_sec)
+        mlflow.log_metric(f"steps_per_sec_{self._n_try}", steps_per_sec, step=step)
+        if self._runtime is not None:
+            event.broadcast(
+                self._runtime.kv,
+                f"{self._runtime.task}/steps_per_sec",
+                f"{steps_per_sec:.3f}",
+            )
+            event.broadcast(
+                self._runtime.kv, f"{self._runtime.task}/last_training_step", str(step)
+            )
+
+
+def _make_tb_writer(model_dir: Optional[str]):
+    if not model_dir:
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir=f"{model_dir}/tb")
+    except Exception:  # tensorboard optional, as in the reference
+        return None
+
+
+def train_and_evaluate(
+    core: CoreExperiment,
+    runtime=None,
+    devices=None,
+) -> Dict[str, float]:
+    """Run the full train/eval/checkpoint loop; returns final metrics.
+
+    The driver-visible lifecycle (train_eval timer events, steps/sec
+    broadcasts) matches the reference's `_execute_dispatched_function`
+    surface (tf_task_common.py:38-74) so run Metrics keep working.
+    """
+    params_cfg = core.train_params
+    mesh_spec = core.mesh_spec
+    if mesh_spec is None:
+        n = len(devices) if devices is not None else len(mesh_lib.select_devices())
+        mesh_spec = mesh_lib.MeshSpec.auto(n)
+    mesh = mesh_lib.build_mesh(mesh_spec, devices)
+    _logger.info(
+        "mesh %s over %d devices", dict(zip(mesh.axis_names, mesh.devices.shape)),
+        mesh.devices.size,
+    )
+
+    train_iter = core.train_input_fn()
+    first_batch = next(train_iter)
+    init_fn = core.init_fn or _default_init_fn(core.model)
+    rng = jax.random.PRNGKey(params_cfg.seed)
+    init_rng, train_rng = jax.random.split(rng)
+
+    globalize = make_batch_globalizer(mesh)
+    first_global = globalize(first_batch)
+
+    def init_state(init_rng, batch):
+        variables = init_fn(init_rng, batch)
+        params = sharding_lib.unbox_params(variables)
+        opt_state = core.optimizer.init(params)
+        return TrainState(np.int32(0), params, opt_state)
+
+    def init_state_boxed(init_rng, batch):
+        # Annotation-preserving twin of init_state: flax Partitioned boxes
+        # are pytree nodes, so optax's zeros_like trees keep the boxes (and
+        # their logical names) on every param-shaped optimizer slot.
+        variables = init_fn(init_rng, batch)
+        opt_state = core.optimizer.init(variables)
+        return TrainState(np.int32(0), variables, opt_state)
+
+    # Sharding decisions come from the boxed abstract state: annotated
+    # leaves (params + matching optimizer slots) follow LOGICAL_RULES, the
+    # rest gets FSDP inference / replication. Each box collapses to one
+    # spec leaf, so the spec tree matches the *unboxed* runtime state.
+    abstract_boxed = jax.eval_shape(init_state_boxed, init_rng, first_global)
+    state_shardings = _named_shardings(mesh, abstract_boxed)
+
+    with mesh:
+        init_jit = jax.jit(init_state, out_shardings=state_shardings)
+        state = init_jit(init_rng, first_global)
+
+        resume_step = 0
+        if core.model_dir:
+            restored, step = ckpt_lib.restore_latest(core.model_dir, target=state)
+            if restored is not None:
+                state = restored
+                resume_step = int(step)
+                _logger.info("resumed from checkpoint step %d", resume_step)
+
+        train_step = jax.jit(
+            build_train_step(core.model, core.loss_fn, core.optimizer),
+            donate_argnums=(0,),
+            out_shardings=(state_shardings, None),
+        )
+        eval_step = jax.jit(build_eval_step(core.model, core.loss_fn))
+
+        hook = _StepsPerSecondHook(
+            runtime, params_cfg.log_every_steps,
+            n_try=runtime.n_try if runtime is not None else 0,
+        )
+        tb_writer = _make_tb_writer(core.model_dir)
+
+        metrics_host: Dict[str, float] = {}
+        batch = first_global
+        step = resume_step
+        while step < params_cfg.train_steps:
+            state, metrics = train_step(state, batch, train_rng)
+            step += 1
+            if step % params_cfg.log_every_steps == 0 or step == params_cfg.train_steps:
+                metrics_host = {k: float(v) for k, v in metrics.items()}
+                hook.after_step(step, metrics_host, force=step == params_cfg.train_steps)
+                if tb_writer is not None:
+                    for key, value in metrics_host.items():
+                        tb_writer.add_scalar(f"train/{key}", value, step)
+            if (
+                params_cfg.checkpoint_every_steps
+                and step % params_cfg.checkpoint_every_steps == 0
+                and core.model_dir
+            ):
+                ckpt_lib.save_checkpoint(core.model_dir, step, state)
+            if (
+                params_cfg.eval_every_steps
+                and core.eval_input_fn
+                and step % params_cfg.eval_every_steps == 0
+            ):
+                eval_metrics = evaluate(
+                    eval_step, state, core.eval_input_fn, globalize,
+                    params_cfg.eval_steps, train_rng,
+                )
+                _logger.info("eval @ step %d: %s", step, eval_metrics)
+                if tb_writer is not None:
+                    for key, value in eval_metrics.items():
+                        tb_writer.add_scalar(f"eval/{key}", value, step)
+            if step < params_cfg.train_steps:
+                try:
+                    batch = globalize(next(train_iter))
+                except StopIteration:
+                    _logger.info("input exhausted at step %d", step)
+                    break
+
+        if core.model_dir:
+            ckpt_lib.save_checkpoint(core.model_dir, step, state)
+        if core.eval_input_fn:
+            final_eval = evaluate(
+                eval_step, state, core.eval_input_fn, globalize,
+                params_cfg.eval_steps, train_rng,
+            )
+            metrics_host.update({f"eval_{k}": v for k, v in final_eval.items()})
+        if tb_writer is not None:
+            tb_writer.close()
+    return metrics_host
+
+
+def evaluate(eval_step, state, eval_input_fn, globalize, max_steps, rng):
+    totals: Dict[str, float] = {}
+    count = 0
+    for batch in eval_input_fn():
+        metrics = eval_step(state, globalize(batch), rng)
+        for key, value in metrics.items():
+            totals[key] = totals.get(key, 0.0) + float(value)
+        count += 1
+        if count >= max_steps:
+            break
+    return {k: v / max(count, 1) for k, v in totals.items()}

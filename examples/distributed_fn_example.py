@@ -1,0 +1,33 @@
+"""Generic fn-of-rank mode (reference analog:
+examples/pytorch/pytorch_distributed_example.py using tf_yarn.distributed).
+
+The experiment is just a function receiving TaskParameters — no model
+plumbing; every process does whatever it wants with its rank.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def experiment_fn():
+    def run(params):
+        print(
+            f"hello from {params.task_type}:{params.task_id} "
+            f"rank={params.rank}/{params.world_size} "
+            f"master={params.master_addr}:{params.master_port}"
+        )
+
+    return run
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import TaskSpec, run_on_tpu
+
+    run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=2, nb_proc_per_worker=2)},
+        custom_task_module="tf_yarn_tpu.tasks.distributed",
+        name="distributed_fn",
+    )

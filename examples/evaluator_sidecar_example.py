@@ -1,0 +1,55 @@
+"""Side-car evaluation + TensorBoard (reference analog:
+examples/id_estimator_example.py topology with evaluator + tensorboard
+tasks from examples/keras_example.py).
+
+Three tasks: a worker training with periodic checkpoints, an evaluator
+polling the checkpoint dir on CPU, and a TensorBoard service advertising
+its URL through the KV store (printed once by the driver).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_DIR = os.path.join(tempfile.gettempdir(), "tpu_yarn_sidecar_demo")
+
+
+def experiment_fn():
+    from tf_yarn_tpu.models import mnist
+    from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+    return mnist.make_experiment(
+        model_dir=MODEL_DIR,
+        train_steps=60,
+        batch_size=64,
+        mesh_spec=MeshSpec(fsdp=8),
+        checkpoint_every_steps=20,
+        log_every_steps=20,
+    )
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import NodeLabel, TaskSpec, run_on_tpu
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {
+            "worker": TaskSpec(instances=1),
+            "evaluator": TaskSpec(instances=1, label=NodeLabel.CPU),
+            "tensorboard": TaskSpec(
+                instances=1,
+                label=NodeLabel.CPU,
+                tb_model_dir=MODEL_DIR,
+                tb_termination_timeout_seconds=0,
+            ),
+        },
+        env={
+            "TPU_YARN_PLATFORM": os.environ.get("EXAMPLE_PLATFORM", "cpu"),
+            "TPU_YARN_VIRTUAL_DEVICES": "8",
+            "TPU_YARN_EVAL_IDLE_TIMEOUT": "60",
+        },
+        name="sidecar_demo",
+    )
+    print("run metrics:", metrics)

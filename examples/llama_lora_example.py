@@ -1,0 +1,54 @@
+"""LoRA fine-tune of the llama-style decoder — BASELINE.json config 5.
+
+New capability with no reference analog: FSDP+TP mesh, frozen base
+weights, LoRA adapters trained, ring attention available by flipping
+`attention_impl="ring"` for long sequences over the sp axis.
+
+The default config here is a small decoder so the example runs anywhere;
+substitute `TransformerConfig.llama3_8b(lora_rank=16)` on a v5e-16.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("TPU_YARN_VIRTUAL_DEVICES", "8")
+os.environ.setdefault("TPU_YARN_PLATFORM", os.environ.get("EXAMPLE_PLATFORM", "cpu"))
+
+MODEL_DIR = os.path.join(tempfile.gettempdir(), "tpu_yarn_llama_lora")
+
+
+def experiment_fn():
+    from tf_yarn_tpu.models.transformer import TransformerConfig, make_experiment
+    from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+    config = TransformerConfig(
+        vocab_size=1024,
+        d_model=256,
+        n_layers=4,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=512,
+        max_seq_len=512,
+        lora_rank=8,
+    )
+    return make_experiment(
+        config,
+        model_dir=MODEL_DIR,
+        train_steps=30,
+        batch_size=8,
+        seq_len=128,
+        learning_rate=1e-4,
+        mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2),
+        log_every_steps=5,
+    )
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import TaskSpec, run_on_tpu
+
+    metrics = run_on_tpu(
+        experiment_fn, {"worker": TaskSpec(instances=1)}, name="llama_lora"
+    )
+    print("run metrics:", metrics)

@@ -1,0 +1,69 @@
+"""KerasExperiment-shaped run (reference analog: examples/keras_example.py).
+
+A dense MNIST-style classifier through the Keras experiment surface:
+separate feature/target streams, validation stream, checkpoints to
+model_dir — trained by the pjit loop on whatever devices are present.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("TPU_YARN_VIRTUAL_DEVICES", "8")
+os.environ.setdefault("TPU_YARN_PLATFORM", os.environ.get("EXAMPLE_PLATFORM", "cpu"))
+
+MODEL_DIR = os.path.join(tempfile.gettempdir(), "tpu_yarn_mnist_keras")
+
+
+def experiment_fn():
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu import KerasExperiment, TrainParams
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.mnist import DenseClassifier
+    from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+    rng = np.random.RandomState(0)
+
+    def features():
+        while True:
+            yield {"x": rng.randn(64, 784).astype(np.float32)}
+
+    def targets():
+        while True:
+            yield rng.randint(0, 10, 64).astype(np.int32)
+
+    def validation():
+        for _ in range(4):
+            yield {
+                "x": rng.randn(64, 784).astype(np.float32),
+                "y": rng.randint(0, 10, 64).astype(np.int32),
+            }
+
+    return KerasExperiment(
+        model=DenseClassifier(num_classes=10),
+        model_dir=MODEL_DIR,
+        train_params=TrainParams(
+            train_steps=50, checkpoint_every_steps=25, log_every_steps=10
+        ),
+        input_data_fn=features,
+        target_data_fn=targets,
+        validation_data_fn=validation,
+        optimizer=optax.adam(1e-3),
+        loss_fn=common.classification_loss,
+        mesh_spec=MeshSpec(fsdp=8),
+    )
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import TaskSpec, run_on_tpu
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=1)},
+        name="mnist_keras",
+    )
+    print("run metrics:", metrics)
+    print("checkpoints in", MODEL_DIR, os.listdir(MODEL_DIR))

@@ -1,0 +1,67 @@
+"""PytorchExperiment run (reference analog: examples/pytorch/pytorch_example.py).
+
+DDP training of a small CNN through the pytorch worker: gloo locally,
+torch-xla's "xla" backend automatically on TPU hosts.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_DIR = os.path.join(tempfile.gettempdir(), "tpu_yarn_pytorch")
+
+
+def experiment_fn():
+    import torch
+
+    from tf_yarn_tpu.pytorch import DataLoaderArgs, PytorchExperiment
+
+    x = torch.randn(256, 1, 16, 16)
+    y = (x.mean(dim=(1, 2, 3)) > 0).long()
+    dataset = torch.utils.data.TensorDataset(x, y)
+
+    model = torch.nn.Sequential(
+        torch.nn.Conv2d(1, 8, 3, padding=1),
+        torch.nn.ReLU(),
+        torch.nn.AdaptiveAvgPool2d(1),
+        torch.nn.Flatten(),
+        torch.nn.Linear(8, 2),
+    )
+
+    def main_fn(model, loader, device, rank, tb_writer):
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        loss_fn = torch.nn.CrossEntropyLoss()
+        for epoch in range(2):
+            for step, (xb, yb) in enumerate(loader):
+                opt.zero_grad()
+                loss = loss_fn(model(xb.to(device)), yb.to(device))
+                loss.backward()
+                opt.step()
+            if rank == 0:
+                print(f"epoch {epoch}: loss={loss.item():.4f}")
+                if tb_writer is not None:
+                    tb_writer.add_scalar("loss", loss.item(), epoch)
+        if rank == 0:
+            from tf_yarn_tpu.utils import model_ckpt
+
+            model_ckpt.save_ckpt(MODEL_DIR, model, opt, epoch=2)
+
+    return PytorchExperiment(
+        model=model,
+        main_fn=main_fn,
+        train_dataset=dataset,
+        dataloader_args=DataLoaderArgs(batch_size=32),
+        tensorboard_log_dir=os.path.join(MODEL_DIR, "tb"),
+    )
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import TaskSpec
+    from tf_yarn_tpu.pytorch import run_on_tpu
+
+    metrics = run_on_tpu(
+        experiment_fn, {"worker": TaskSpec(instances=2)}, name="pytorch_ddp"
+    )
+    print("run metrics:", metrics)

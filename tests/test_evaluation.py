@@ -1,0 +1,67 @@
+"""Side-car evaluator tests: checkpoint discovery, exactly-once eval,
+stop conditions (reference: tests/tensorflow/test_evaluator_task.py)."""
+
+import json
+import os
+
+import numpy as np
+
+from tf_yarn_tpu import evaluation
+from tf_yarn_tpu.experiment import as_core_experiment
+from tf_yarn_tpu.models import mnist
+from tf_yarn_tpu.parallel.mesh import MeshSpec, select_devices
+from tf_yarn_tpu.training import train_and_evaluate
+
+
+def _train_with_ckpts(tmp_path, steps=10, every=5):
+    experiment = mnist.make_experiment(
+        model_dir=str(tmp_path),
+        train_steps=steps,
+        batch_size=32,
+        feature_dim=16,
+        num_classes=4,
+        mesh_spec=MeshSpec(fsdp=8),
+        checkpoint_every_steps=every,
+    )
+    experiment.model = mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4)
+    train_and_evaluate(
+        as_core_experiment(experiment), devices=select_devices(8, platform="cpu")
+    )
+    return experiment
+
+
+def test_continuous_eval_evaluates_each_ckpt_once(tmp_path):
+    experiment = _train_with_ckpts(tmp_path)
+    metrics = evaluation.continuous_eval(
+        None, experiment, poll_secs=0.1, idle_timeout_secs=5.0
+    )
+    assert np.isfinite(metrics["loss"])
+    done = evaluation._evaluated_steps(str(tmp_path))
+    assert done == {5, 10}
+    # Marker files carry the metrics payload.
+    with open(os.path.join(str(tmp_path), "eval-done-10.json")) as fh:
+        assert "loss" in json.load(fh)
+
+
+def test_continuous_eval_skips_already_evaluated(tmp_path):
+    experiment = _train_with_ckpts(tmp_path)
+    evaluation.continuous_eval(None, experiment, poll_secs=0.1, idle_timeout_secs=5.0)
+    # Second run: nothing new to evaluate; returns promptly with {} since
+    # the final checkpoint is already marked done.
+    metrics = evaluation.continuous_eval(
+        None, experiment, poll_secs=0.1, idle_timeout_secs=2.0
+    )
+    assert metrics == {}
+
+
+def test_continuous_eval_idle_timeout(tmp_path):
+    # No final checkpoint appears (train_steps larger than what exists):
+    # the evaluator must give up after the idle timeout.
+    experiment = _train_with_ckpts(tmp_path, steps=5, every=5)
+    experiment.train_params.train_steps = 100
+    import time
+
+    t0 = time.time()
+    evaluation.continuous_eval(None, experiment, poll_secs=0.1, idle_timeout_secs=1.5)
+    assert time.time() - t0 < 30
+    assert evaluation._evaluated_steps(str(tmp_path)) == {5}

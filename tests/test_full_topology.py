@@ -1,0 +1,53 @@
+"""Full-topology e2e: worker + evaluator + tensorboard side-cars through
+the launcher — the complete §3.1 driver surface with real processes."""
+
+import os
+
+from tf_yarn_tpu import evaluation
+from tf_yarn_tpu.client import run_on_tpu
+from tf_yarn_tpu.topologies import NodeLabel, TaskSpec
+
+
+def test_worker_evaluator_tensorboard(tmp_path):
+    model_dir = str(tmp_path / "model")
+
+    def experiment_fn():
+        from tf_yarn_tpu.models import mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        experiment = mnist.make_experiment(
+            model_dir=model_dir,
+            train_steps=10,
+            batch_size=32,
+            feature_dim=16,
+            num_classes=4,
+            mesh_spec=MeshSpec(fsdp=8),
+            checkpoint_every_steps=5,
+        )
+        experiment.model = mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4)
+        return experiment
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {
+            "worker": TaskSpec(instances=1),
+            "evaluator": TaskSpec(instances=1, label=NodeLabel.CPU),
+            "tensorboard": TaskSpec(
+                instances=1,
+                label=NodeLabel.CPU,
+                tb_model_dir=model_dir,
+                tb_termination_timeout_seconds=0,
+            ),
+        },
+        env={
+            "TPU_YARN_PLATFORM": "cpu",
+            "TPU_YARN_VIRTUAL_DEVICES": "8",
+            "TPU_YARN_EVAL_IDLE_TIMEOUT": "45",
+        },
+        poll_every_secs=0.3,
+    )
+    # Training ran and both checkpoints were evaluated by the side-car.
+    assert metrics.total_training_duration is not None
+    assert evaluation._evaluated_steps(model_dir) == {5, 10}
+    # Evaluator contributed its own timer events.
+    assert "evaluator:0" in metrics.container_duration

@@ -4,12 +4,32 @@ Brand-new implementation of the capability surface of criteo/tf-yarn
 (reference mounted at /root/reference; structural map in SURVEY.md),
 re-designed for TPU: slice placement instead of YARN containers, an
 in-repo coordination service instead of the skein ApplicationMaster, and
-JAX/XLA collectives over ICI instead of ParameterServerStrategy, Horovod/
-Gloo and NCCL.
+JAX/XLA collectives over ICI instead of ParameterServerStrategy,
+Horovod/Gloo and NCCL.
 
-Public surface (analog of reference tf_yarn/__init__.py:1-8):
+Public surface (analog of reference tf_yarn/__init__.py:1-8 +
+tf_yarn/tensorflow/__init__.py + tf_yarn/pytorch/__init__.py):
+
+    from tf_yarn_tpu import run_on_tpu, TaskSpec, NodeLabel
+    from tf_yarn_tpu import JaxExperiment, KerasExperiment, ExperimentSpec
+    from tf_yarn_tpu.pytorch import PytorchExperiment
 """
 
+from tf_yarn_tpu.client import (  # noqa: F401
+    RunFailed,
+    get_safe_experiment_fn,
+    run_on_tpu,
+)
+from tf_yarn_tpu.experiment import (  # noqa: F401
+    Estimator,
+    EvalSpec,
+    ExperimentSpec,
+    JaxExperiment,
+    KerasExperiment,
+    TrainParams,
+    TrainSpec,
+)
+from tf_yarn_tpu.parallel.mesh import MeshSpec  # noqa: F401
 from tf_yarn_tpu.topologies import (  # noqa: F401
     NodeLabel,
     TaskKey,
@@ -18,14 +38,27 @@ from tf_yarn_tpu.topologies import (  # noqa: F401
     single_server_topology,
     tpu_slice_topology,
 )
+from tf_yarn_tpu.utils.metrics import Metrics  # noqa: F401
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "Estimator",
+    "EvalSpec",
+    "ExperimentSpec",
+    "JaxExperiment",
+    "KerasExperiment",
+    "MeshSpec",
+    "Metrics",
     "NodeLabel",
+    "RunFailed",
     "TaskKey",
     "TaskSpec",
+    "TrainParams",
+    "TrainSpec",
     "allreduce_topology",
+    "get_safe_experiment_fn",
+    "run_on_tpu",
     "single_server_topology",
     "tpu_slice_topology",
 ]

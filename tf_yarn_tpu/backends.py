@@ -126,8 +126,11 @@ class _LocalHandle(ClusterHandle):
             except subprocess.TimeoutExpired:
                 proc.kill()
 
-    def reap_sidecars(self, timeout: float = 10.0) -> None:
-        """Stop side-cars that outlive the primaries (TB lingers by design)."""
+    def reap_sidecars(self, timeout: float = 90.0) -> None:
+        """Stop side-cars that outlive the primaries. The timeout is the
+        grace for the evaluator to finish its final checkpoint (it exits on
+        its own once training's stop events are in and nothing is pending);
+        TB lingers only its configured termination timeout."""
         for key, proc in self._procs.items():
             if key.type in PRIMARY_TASK_TYPES or proc.poll() is not None:
                 continue

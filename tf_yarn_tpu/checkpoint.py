@@ -70,6 +70,24 @@ def restore_checkpoint(model_dir: str, step: int, target: Optional[Any] = None) 
         return ckptr.restore(path, abstract)
 
 
+def restore_checkpoint_host(model_dir: str, step: int) -> Any:
+    """Restore ckpt-<step> as plain numpy on the host, regardless of the
+    device topology it was saved under (the side-car evaluator restores
+    8-mesh checkpoints on its single CPU device this way)."""
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(checkpoint_path(model_dir, step))
+    with ocp.PyTreeCheckpointer() as ckptr:
+        item = ckptr.metadata(path).item_metadata
+        tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
+        restore_args = jax.tree_util.tree_map(
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+        )
+        return ckptr.restore(path, restore_args=restore_args)
+
+
 def restore_latest(model_dir: str, target: Optional[Any] = None):
     """(state, step) of the newest checkpoint, or (None, None) — the resume
     path the retry loop relies on (reference resumes from model_dir,

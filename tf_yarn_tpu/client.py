@@ -120,6 +120,10 @@ def _setup_task_env(
             tracking_uri = mlflow.get_tracking_uri()
             if tracking_uri:
                 task_env.setdefault("MLFLOW_TRACKING_URI", tracking_uri)
+        if task_type == "evaluator":
+            # CPU side-car: never grabs the slice's chips (SURVEY §7 hard
+            # part 5 — placement the reference got free from YARN labels).
+            task_env.setdefault("TPU_YARN_PLATFORM", "cpu")
         if task_type == "tensorboard":
             if spec.tb_model_dir:
                 task_env.setdefault("TB_MODEL_DIR", spec.tb_model_dir)

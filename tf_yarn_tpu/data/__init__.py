@@ -1,0 +1,4 @@
+from tf_yarn_tpu.data.parquet import ParquetDataset
+from tf_yarn_tpu.data.prefetch import prefetch
+
+__all__ = ["ParquetDataset", "prefetch"]

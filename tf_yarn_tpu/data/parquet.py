@@ -1,0 +1,111 @@
+"""Streaming Parquet input pipeline with sample-level rank sharding.
+
+Rebuild of the reference's ParquetDataset (reference:
+pytorch/parquet_dataset.py:15-72) with its two defects fixed by design
+(SURVEY.md §7.8):
+
+* The reference shards *batches* and silently drops the tail batch of
+  every file per rank (parquet_dataset.py:37-48) — here sharding is
+  *sample-level* (row i belongs to rank i % world_size), so every sample
+  is seen by exactly one rank.
+* Static shapes for XLA: only full `batch_size` batches are emitted
+  (`drop_last` semantics are mandatory on TPU — the compile-shape hazard
+  the reference merely documents, pytorch/experiment.py:10-15).
+
+Works against any pyarrow-compatible filesystem (local, HDFS, GCS via
+pyarrow.fs), the cluster_pack.filesystem role in the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+
+class ParquetDataset:
+    """Iterable over {column: np.ndarray} batches of exactly `batch_size`.
+
+    rank/world_size default to the single-process case; the pytorch worker
+    and JAX input functions pass their own.
+    """
+
+    def __init__(
+        self,
+        paths: "str | Sequence[str]",
+        batch_size: int,
+        columns: Optional[List[str]] = None,
+        rank: int = 0,
+        world_size: int = 1,
+        filesystem=None,
+        repeat: bool = False,
+    ) -> None:
+        if isinstance(paths, str):
+            paths = [paths]
+        self.paths = list(paths)
+        self.batch_size = batch_size
+        self.columns = columns
+        self.rank = rank
+        self.world_size = world_size
+        self.filesystem = filesystem
+        self.repeat = repeat
+
+    def num_samples(self) -> int:
+        """Total rows across files from parquet metadata only (the
+        reference reads footers in an mp.Pool, parquet_dataset.py:58-65;
+        sequential metadata reads are already cheap)."""
+        import pyarrow.parquet as pq
+
+        total = 0
+        for path in self.paths:
+            total += pq.ParquetFile(
+                path, filesystem=self.filesystem
+            ).metadata.num_rows
+        return total
+
+    def _iter_rows(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Yield this rank's samples, file by file, row-group by row-group."""
+        import pyarrow.parquet as pq
+
+        global_idx = 0
+        for path in self.paths:
+            pf = pq.ParquetFile(path, filesystem=self.filesystem)
+            for rg in range(pf.num_row_groups):
+                table = pf.read_row_group(rg, columns=self.columns)
+                n = table.num_rows
+                # Rows of this group occupy [global_idx, global_idx + n);
+                # rank r owns global rows where idx % world == r.
+                first = (self.rank - global_idx) % self.world_size
+                if first < n:
+                    arrays = {
+                        name: col.to_numpy(zero_copy_only=False)
+                        for name, col in zip(table.column_names, table.columns)
+                    }
+                    take = slice(first, n, self.world_size)
+                    yield {name: arr[take] for name, arr in arrays.items()}
+                global_idx += n
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            buffers: Dict[str, List[np.ndarray]] = {}
+            buffered = 0
+            for chunk in self._iter_rows():
+                if not buffers:
+                    buffers = {k: [] for k in chunk}
+                for key, arr in chunk.items():
+                    buffers[key].append(arr)
+                buffered += len(next(iter(chunk.values())))
+                while buffered >= self.batch_size:
+                    merged = {k: np.concatenate(v) for k, v in buffers.items()}
+                    batch = {k: v[: self.batch_size] for k, v in merged.items()}
+                    buffers = {
+                        k: [v[self.batch_size:]] for k, v in merged.items()
+                    }
+                    buffered -= self.batch_size
+                    yield batch
+            # tail (< batch_size) dropped: static shapes for XLA
+            if not self.repeat:
+                return

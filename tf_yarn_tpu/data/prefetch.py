@@ -1,0 +1,51 @@
+"""Host→device input prefetch.
+
+The HBM-feeding half of the input pipeline (SURVEY.md §7.8): batches are
+pushed to device (already sharded for the mesh) a few steps ahead of the
+compute stream on a background thread, so the jitted step never waits on
+host IO. JAX's async dispatch overlaps the transfer with the running step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+
+def prefetch(
+    iterator: Iterator,
+    place_fn: Optional[Callable] = None,
+    depth: int = 2,
+) -> Iterator:
+    """Yield items from `iterator`, staging up to `depth` ahead.
+
+    `place_fn` maps a host batch to device arrays (e.g. the train loop's
+    batch globalizer); placement happens on the background thread so the
+    consumer only ever sees device-resident batches.
+    """
+    if depth < 1:
+        yield from (place_fn(item) if place_fn else item for item in iterator)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer() -> None:
+        try:
+            for item in iterator:
+                q.put(place_fn(item) if place_fn else item)
+        except BaseException as exc:  # surface in consumer
+            q.put(("__prefetch_error__", exc))
+        finally:
+            q.put(_END)
+
+    thread = threading.Thread(target=producer, name="input-prefetch", daemon=True)
+    thread.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
+            raise item[1]
+        yield item

@@ -38,6 +38,10 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
+    # LoRA fields make BertConfig duck-compatible with transformer.LoraDense
+    # (rank 0 = plain dense; raise for adapter fine-tuning).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @property
     def head_dim(self) -> int:
@@ -57,26 +61,13 @@ class BertConfig:
         return cls(**defaults)
 
 
-class _Dense(nn.Module):
-    features: int
-    names: tuple
-    config: BertConfig
+def _Dense(features: int, names: tuple, config: BertConfig, name: str):
+    """Partitioned dense with bias — the transformer family's LoraDense
+    (one sharded-dense implementation for both model families; BERT gains
+    LoRA fine-tuning through BertConfig.lora_rank for free)."""
+    from tf_yarn_tpu.models.transformer import LoraDense
 
-    @nn.compact
-    def __call__(self, x):
-        cfg = self.config
-        kernel = self.param(
-            "kernel",
-            _partitioned(self.names)(nn.initializers.normal(stddev=0.02)),
-            (x.shape[-1], self.features),
-            cfg.param_dtype,
-        )
-        bias = self.param(
-            "bias", nn.initializers.zeros_init(), (self.features,), cfg.param_dtype
-        )
-        return jnp.einsum("...d,df->...f", x, kernel.astype(cfg.dtype)) + bias.astype(
-            cfg.dtype
-        )
+    return LoraDense(features, names, config, use_bias=True, name=name)
 
 
 class EncoderBlock(nn.Module):
@@ -189,9 +180,9 @@ def make_experiment(
             labels = (tokens[:, 0] % config.num_classes).astype(np.int32)
             yield {"x": tokens.astype(np.int32), "y": labels}
 
-    def loss_fn(model, params, batch, rng):
+    def loss_fn(model, params, batch, rng, train=True):
         logits = model.apply(params, batch["x"], rngs={"dropout": rng},
-                             deterministic=False)
+                             deterministic=not train)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["y"]
         ).mean()

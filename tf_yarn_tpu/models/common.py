@@ -11,29 +11,36 @@ import jax.numpy as jnp
 import optax
 
 
-def classification_loss(model, params, batch, rng):
-    """Softmax cross-entropy + accuracy for models mapping x -> logits."""
-    logits = model.apply(params, batch["x"], rngs={"dropout": rng})
+def classification_loss(model, params, batch, rng, train=True):
+    """Softmax cross-entropy + accuracy for models mapping x -> logits.
+    `train=False` disables dropout (zoo models take `deterministic`)."""
+    logits = model.apply(
+        params, batch["x"], rngs={"dropout": rng}, deterministic=not train
+    )
     labels = batch["y"]
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
     accuracy = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
     return loss, {"accuracy": accuracy}
 
 
-def binary_logistic_loss(model, params, batch, rng):
+def binary_logistic_loss(model, params, batch, rng, train=True):
     """Sigmoid cross-entropy for models mapping x -> a single logit."""
-    logits = model.apply(params, batch["x"], rngs={"dropout": rng}).squeeze(-1)
+    logits = model.apply(
+        params, batch["x"], rngs={"dropout": rng}, deterministic=not train
+    ).squeeze(-1)
     labels = batch["y"].astype(jnp.float32)
     loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
     accuracy = jnp.mean((logits > 0) == (labels > 0.5))
     return loss, {"accuracy": accuracy}
 
 
-def lm_loss(model, params, batch, rng):
+def lm_loss(model, params, batch, rng, train=True):
     """Next-token cross-entropy for causal LMs: batch has "tokens"
     [B, S] int32; loss over positions 0..S-2 predicting 1..S-1."""
     tokens = batch["tokens"]
-    logits = model.apply(params, tokens, rngs={"dropout": rng})
+    logits = model.apply(
+        params, tokens, rngs={"dropout": rng}, deterministic=not train
+    )
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)

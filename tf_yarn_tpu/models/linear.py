@@ -31,7 +31,7 @@ class HashedLinearClassifier(nn.Module):
     config: LinearConfig
 
     @nn.compact
-    def __call__(self, x, dense=None):
+    def __call__(self, x, dense=None, deterministic: bool = True):
         cfg = self.config
         table = self.param(
             "weights",
@@ -52,11 +52,15 @@ class HashedLinearClassifier(nn.Module):
 
 def hash_features(raw: "list[str] | object", n_buckets: int):
     """Host-side feature hashing (the analog of TF's
-    categorical_column_with_hash_bucket)."""
+    categorical_column_with_hash_bucket). Uses crc32, which is stable
+    across processes and runs — Python's builtin hash() is salted per
+    process, which would scatter a checkpoint's weight rows on resume."""
+    import zlib
+
     import numpy as np
 
     def bucket(value: str) -> int:
-        return hash(value) % n_buckets
+        return zlib.crc32(str(value).encode("utf-8")) % n_buckets
 
     return np.asarray([[bucket(v) for v in row] for row in raw], dtype=np.int32)
 

@@ -72,7 +72,8 @@ class ResNet(nn.Module):
     config: ResNetConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
+        # deterministic accepted for loss-contract uniformity (no dropout).
         cfg = self.config
         x = x.astype(cfg.dtype)
         x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,

@@ -235,7 +235,9 @@ class Transformer(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, deterministic: bool = True):
+        # deterministic accepted for loss-contract uniformity (this
+        # decoder family carries no dropout).
         cfg = self.config
         embedding = self.param(
             "embedding",

@@ -416,10 +416,17 @@ void ServeConnection(Store* store, int fd) {
     std::map<std::string, JsonValue> req;
     JsonParser parser(payload);
     std::string reply;
-    if (!parser.ParseObject(&req)) {
-      reply = R"({"ok":false,"error":"bad json"})";
-    } else {
-      reply = Handle(*store, req);
+    // Malformed numbers / escapes / non-numeric incr values throw from
+    // std::stoll & friends; a bad client frame must never kill the run's
+    // control plane (the Python server replies ok:false the same way).
+    try {
+      if (!parser.ParseObject(&req)) {
+        reply = R"({"ok":false,"error":"bad json"})";
+      } else {
+        reply = Handle(*store, req);
+      }
+    } catch (const std::exception& e) {
+      reply = std::string(R"({"ok":false,"error":")") + JsonEscape(e.what()) + "\"}";
     }
     if (!SendFrame(fd, reply)) break;
     if (g_shutdown) break;

@@ -1,0 +1,187 @@
+"""Flash attention — pallas TPU kernel with blockwise online softmax.
+
+The HBM-bandwidth-saving attention for long sequences: logits are never
+materialized in HBM; each (q-block, kv-block) tile lives in VMEM with
+running max / sum-exp / output accumulators carried across kv blocks
+(per /opt/skills/guides/pallas_guide.md: grid+BlockSpec tiling, f32
+accumulation, MXU dots with preferred_element_type).
+
+Backward runs through a custom VJP that recomputes attention with the XLA
+reference implementation (rematerialization: the standard FLOPs-for-HBM
+trade; a dedicated pallas backward kernel is a later optimization).
+
+Interface matches tf_yarn_tpu.ops.attention: q [B,S,H,D], k/v [B,Skv,Hkv,D].
+Runs in interpreter mode automatically off-TPU so the same code path is
+testable on the CPU rig.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  softmax_scale: float):
+    """One q-block vs all kv-blocks. Refs carry a leading block dim of 1:
+    q (1, block_q, d), k/v (1, s_kv, d), o (1, block_q, d).
+    Grid: (batch*heads, s_q // block_q)."""
+    _, block_q, head_dim = q_ref.shape
+    s_kv = k_ref.shape[1]
+    q_block_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * softmax_scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    num_kv_blocks = s_kv // block_k
+
+    def body(kv_idx, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_blk = k_ref[0, pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            q_pos = (
+                q_block_idx * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            )
+            k_pos = (
+                kv_idx * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            )
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # kv blocks strictly after this q block are fully masked: skip them.
+        upper = jnp.minimum(
+            num_kv_blocks, (q_block_idx + 1) * block_q // block_k + 1
+        )
+    else:
+        upper = num_kv_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    causal: bool,
+    softmax_scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    b, s_q, n_heads, head_dim = query.shape
+    _, s_kv, n_kv, _ = key.shape
+    if n_heads != n_kv:  # GQA: expand kv heads (optimizable later)
+        rep = n_heads // n_kv
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"flash attention needs seq lengths divisible by blocks: "
+            f"s_q={s_q} %% {block_q}, s_kv={s_kv} %% {block_k}"
+        )
+
+    # [B,S,H,D] -> [B*H, S, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * n_heads, x.shape[1], head_dim)
+
+    qb, kb, vb = to_bh(query), to_bh(key), to_bh(value)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, softmax_scale=softmax_scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * n_heads, s_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s_kv, head_dim), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s_kv, head_dim), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, n_heads, s_q, head_dim).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
+    return _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k, interpret
+    )
+
+
+def _flash_fwd(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
+    out = _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k, interpret
+    )
+    return out, (query, key, value)
+
+
+def _flash_bwd(causal, softmax_scale, block_q, block_k, interpret, residuals, g):
+    from tf_yarn_tpu.ops.attention import xla_attention
+
+    query, key, value = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale
+        ),
+        query,
+        key,
+        value,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    *,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise (flash) attention; differentiable via recompute-backward."""
+    if softmax_scale is None:
+        softmax_scale = query.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(
+        query, key, value, causal, softmax_scale, block_q, block_k, interpret
+    )

@@ -1,0 +1,98 @@
+"""Collective helpers + ICI bandwidth microbenchmark.
+
+The data-plane primitives that replace the reference's Horovod/Gloo rings
+and NCCL (SURVEY.md §2.4): thin, named wrappers over XLA collectives so
+user code inside shard_map reads like the intent, plus the allreduce
+bandwidth microbench that is one of this repo's two north-star metrics
+(BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def all_reduce_mean(x, axis_name: str):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_reduce_sum(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def reduce_scatter(x, axis_name: str, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_axis, tiled=True
+    )
+
+
+def all_gather(x, axis_name: str, gather_axis: int = 0):
+    return jax.lax.all_gather(x, axis_name, axis=gather_axis, tiled=True)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards `shift` hops around the axis ring (ppermute)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def allreduce_bandwidth(
+    size_mb: float = 64.0,
+    iters: int = 10,
+    devices: Optional[Sequence] = None,
+    axis: str = "x",
+) -> Dict[str, float]:
+    """Measure allreduce algorithmic bandwidth over all local devices.
+
+    Returns {gbps, elapsed_s, size_mb, n_devices}. Algorithmic bandwidth =
+    2*(n-1)/n * bytes / time (ring allreduce cost model) — the number the
+    BASELINE.md north-star table tracks for ICI.
+    """
+    from jax.sharding import Mesh, NamedSharding
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        # Single chip: no interconnect to measure; report memory-bound copy.
+        n = 1
+    mesh = Mesh(np.asarray(devices), (axis,))
+    # Each device contributes a full `size_mb` message (the quantity the
+    # ring-allreduce cost model 2*(n-1)/n * M is defined over).
+    msg_elems = int(size_mb * 1e6 / 4)
+    x = jnp.ones((max(n, 1), msg_elems), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+    @jax.jit
+    def step(x):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, axis) * (1.0 / max(n, 1)),
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )(x)
+
+    out = step(x)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.time()
+    for _ in range(iters):
+        out = step(out)
+    jax.block_until_ready(out)
+    elapsed = (time.time() - t0) / iters
+    msg_bytes = msg_elems * 4
+    algo_factor = 2 * (n - 1) / n if n > 1 else 1.0
+    gbps = algo_factor * msg_bytes / elapsed / 1e9
+    return {
+        "gbps": gbps,
+        "elapsed_s": elapsed,
+        "size_mb": msg_bytes / 1e6,
+        "n_devices": float(len(devices)),
+    }

@@ -155,3 +155,18 @@ def local_device_count() -> int:
     import jax
 
     return jax.local_device_count()
+
+
+# The run's active mesh, registered by the train loop so mesh-aware ops
+# (ring attention's shard_map) can find it from inside model code without
+# threading the mesh through every module signature.
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh():
+    return _CURRENT_MESH

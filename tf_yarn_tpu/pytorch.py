@@ -1,0 +1,105 @@
+"""PyTorch experiment surface — torch-xla on TPU, gloo elsewhere.
+
+Parity with the reference's `tf_yarn.pytorch` package (SURVEY.md §2.2):
+`PytorchExperiment` (reference: pytorch/experiment.py:30-56),
+`DataLoaderArgs` (:6-20), `DistributedDataParallelArgs` (:23-27) and the
+`run_on_tpu` wrapper that defaults the task program to the pytorch worker
+(reference: pytorch/client.py:12-18).
+
+TPU-native differences:
+* The collective backend is torch-xla's "xla" process group over ICI when
+  `torch_xla` is importable, replacing NCCL (reference worker.py:101,
+  171-174); gloo is the CPU fallback (tests, local smoke).
+* `drop_last=True` is *enforced*, not defaulted: uneven batches that merely
+  corrupt allreduce on GPU (reference's warning, experiment.py:10-15) are
+  recompilation storms on XLA.
+* The user contract is unchanged: `main_fn(model, loader, device, rank,
+  tb_writer)` — note the reference annotates 4 params but calls with 5
+  (worker.py:113, SURVEY §2.6); here the signature is 5 by definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+from tf_yarn_tpu import client as _client
+from tf_yarn_tpu.topologies import TaskSpecs
+
+PYTORCH_TASK_MODULE = "tf_yarn_tpu.tasks.pytorch_worker"
+
+
+@dataclasses.dataclass
+class DataLoaderArgs:
+    """reference: pytorch/experiment.py:6-20 (drop_last enforced True)."""
+
+    batch_size: int = 32
+    num_workers: int = 0
+    pin_memory: bool = False
+    drop_last: bool = True
+    shuffle: bool = True
+    prefetch_factor: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if not self.drop_last:
+            raise ValueError(
+                "drop_last=False is not supported on XLA: uneven final "
+                "batches change compile shapes every epoch"
+            )
+
+
+@dataclasses.dataclass
+class DistributedDataParallelArgs:
+    """reference: pytorch/experiment.py:23-27."""
+
+    find_unused_parameters: bool = False
+    gradient_as_bucket_view: bool = False
+
+
+@dataclasses.dataclass
+class PytorchExperiment:
+    model: Any
+    # main_fn(model, train_loader, device, rank, tb_writer)
+    main_fn: Callable
+    train_dataset: Any
+    dataloader_args: DataLoaderArgs = dataclasses.field(default_factory=DataLoaderArgs)
+    tensorboard_log_dir: Optional[str] = None
+    ddp_args: DistributedDataParallelArgs = dataclasses.field(
+        default_factory=DistributedDataParallelArgs
+    )
+    backend: Optional[str] = None  # None = auto: xla if available, else gloo
+
+
+def collective_backend() -> str:
+    """xla (torch-xla over ICI) when present, else gloo — the decision the
+    reference makes between nccl and gloo (worker.py:171-174)."""
+    try:
+        import torch_xla  # noqa: F401
+
+        return "xla"
+    except ImportError:
+        return "gloo"
+
+
+def get_device():
+    """torch-xla device when present, else CPU (reference _get_device,
+    worker.py:162-168 picks cuda round-robin)."""
+    try:
+        import torch_xla.core.xla_model as xm
+
+        return xm.xla_device()
+    except ImportError:
+        import torch
+
+        return torch.device("cpu")
+
+
+def run_on_tpu(
+    experiment_fn: Callable[[], PytorchExperiment],
+    task_specs: Optional[TaskSpecs] = None,
+    **kwargs: Dict[str, Any],
+):
+    """run_on_tpu with the pytorch task program (reference:
+    pytorch/client.py:12-23)."""
+    kwargs.setdefault("custom_task_module", PYTORCH_TASK_MODULE)
+    return _client.run_on_tpu(experiment_fn, task_specs, **kwargs)

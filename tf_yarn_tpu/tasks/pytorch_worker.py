@@ -1,0 +1,136 @@
+"""PyTorch task program: DDP workers over torch-xla (or gloo).
+
+Rebuild of the reference's per-container pytorch worker (reference:
+pytorch/tasks/worker.py:94-218): world size from the cluster layout,
+master election through the KV store, one process per local rank,
+`dist.init_process_group`, DDP-wrapped model, `DistributedSampler` data
+loader, then the user `main_fn(model, loader, device, rank, tb_writer)`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import cloudpickle
+
+from tf_yarn_tpu import _task_commons, event
+from tf_yarn_tpu.tasks import _bootstrap
+from tf_yarn_tpu.tasks.distributed import TaskParameters, parallel_run
+
+_logger = logging.getLogger(__name__)
+
+
+def _make_tb_writer(log_dir: Optional[str]):
+    if not log_dir:
+        return None
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir=log_dir)
+    except Exception:
+        return None
+
+
+def _train_one_rank(experiment, params: TaskParameters) -> None:
+    """Body run in each local-rank process (reference _train,
+    worker.py:94-122)."""
+    import torch
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    from tf_yarn_tpu import pytorch as pt
+
+    backend = experiment.backend or pt.collective_backend()
+    os.environ.setdefault("MASTER_ADDR", params.master_addr)
+    os.environ.setdefault("MASTER_PORT", str(params.master_port))
+    dist.init_process_group(
+        backend=backend, rank=params.rank, world_size=params.world_size
+    )
+    try:
+        device = pt.get_device()
+        model = experiment.model.to(device)
+        if params.world_size > 1 and backend != "xla":
+            from torch.nn.parallel import DistributedDataParallel
+
+            model = DistributedDataParallel(
+                model,
+                find_unused_parameters=experiment.ddp_args.find_unused_parameters,
+                gradient_as_bucket_view=experiment.ddp_args.gradient_as_bucket_view,
+            )
+
+        args = experiment.dataloader_args
+        sampler = DistributedSampler(
+            experiment.train_dataset,
+            num_replicas=params.world_size,
+            rank=params.rank,
+            shuffle=args.shuffle,
+        )
+        loader_kwargs = dict(
+            batch_size=args.batch_size,
+            sampler=sampler,
+            num_workers=args.num_workers,
+            pin_memory=args.pin_memory,
+            drop_last=True,
+        )
+        if args.prefetch_factor is not None and args.num_workers > 0:
+            loader_kwargs["prefetch_factor"] = args.prefetch_factor
+        loader = DataLoader(experiment.train_dataset, **loader_kwargs)
+
+        tb_writer = _make_tb_writer(
+            experiment.tensorboard_log_dir if params.rank == 0 else None
+        )
+        try:
+            experiment.main_fn(model, loader, device, params.rank, tb_writer)
+        finally:
+            if tb_writer is not None:
+                tb_writer.close()
+        _ = torch  # keep import explicit
+    finally:
+        dist.destroy_process_group()
+
+
+def main() -> None:
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        master_addr = _task_commons.choose_master(
+            runtime.kv, runtime.task_key, runtime.cluster_tasks
+        )
+        host, _, port = master_addr.rpartition(":")
+        world_size = _task_commons.compute_world_size(runtime.cluster_tasks)
+        nb_proc = _task_commons.get_nb_proc()
+        base_rank = _task_commons.compute_rank(
+            runtime.task_key, runtime.cluster_tasks, local_rank=0
+        )
+        params_list = [
+            TaskParameters(
+                task_type=runtime.task_key.type,
+                task_id=runtime.task_key.id,
+                rank=base_rank + local_rank,
+                local_rank=local_rank,
+                world_size=world_size,
+                master_addr=host,
+                master_port=int(port),
+                n_workers_per_executor=nb_proc,
+            )
+            for local_rank in range(nb_proc)
+        ]
+        event.start_event(runtime.kv, runtime.task)
+        event.train_eval_start_event(runtime.kv, runtime.task)
+        try:
+            if nb_proc == 1:
+                _train_one_rank(experiment, params_list[0])
+            else:
+                fn_bytes = cloudpickle.dumps(
+                    lambda p: _train_one_rank(experiment, p)
+                )
+                parallel_run(fn_bytes, params_list)
+        finally:
+            event.train_eval_stop_event(runtime.kv, runtime.task)
+
+
+if __name__ == "__main__":
+    main()

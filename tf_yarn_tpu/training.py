@@ -86,11 +86,32 @@ def make_batch_globalizer(mesh):
     return globalize
 
 
+def _loss_caller(loss_fn):
+    """Normalize the loss contract to (model, params, batch, rng, train=...).
+
+    Zoo losses take `train` and flip dropout off for evaluation; 4-arg
+    user losses keep working (train is dropped)."""
+    import inspect
+
+    try:
+        accepts_train = "train" in inspect.signature(loss_fn).parameters
+    except (TypeError, ValueError):  # builtins / partials without signature
+        accepts_train = False
+    if accepts_train:
+        return loss_fn
+    return lambda model, params, batch, rng, train=True: loss_fn(
+        model, params, batch, rng
+    )
+
+
 def build_train_step(model, loss_fn, optimizer):
+    loss_fn = _loss_caller(loss_fn)
+
     def train_step(state: TrainState, batch, base_rng):
         rng = jax.random.fold_in(base_rng, state.step)
         grad_fn = jax.value_and_grad(
-            lambda params: loss_fn(model, params, batch, rng), has_aux=True
+            lambda params: loss_fn(model, params, batch, rng, train=True),
+            has_aux=True,
         )
         (loss, aux), grads = grad_fn(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -102,8 +123,10 @@ def build_train_step(model, loss_fn, optimizer):
 
 
 def build_eval_step(model, loss_fn):
+    loss_fn = _loss_caller(loss_fn)
+
     def eval_step(state: TrainState, batch, base_rng):
-        loss, aux = loss_fn(model, state.params, batch, base_rng)
+        loss, aux = loss_fn(model, state.params, batch, base_rng, train=False)
         return {"loss": loss, **aux}
 
     return eval_step
@@ -168,6 +191,7 @@ def train_and_evaluate(
         n = len(devices) if devices is not None else len(mesh_lib.select_devices())
         mesh_spec = mesh_lib.MeshSpec.auto(n)
     mesh = mesh_lib.build_mesh(mesh_spec, devices)
+    mesh_lib.set_current_mesh(mesh)
     _logger.info(
         "mesh %s over %d devices", dict(zip(mesh.axis_names, mesh.devices.shape)),
         mesh.devices.size,
@@ -229,6 +253,9 @@ def train_and_evaluate(
         tb_writer = _make_tb_writer(core.model_dir)
 
         metrics_host: Dict[str, float] = {}
+        from tf_yarn_tpu.data.prefetch import prefetch
+
+        batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
         batch = first_global
         step = resume_step
         while step < params_cfg.train_steps:
@@ -261,11 +288,17 @@ def train_and_evaluate(
                         tb_writer.add_scalar(f"eval/{key}", value, step)
             if step < params_cfg.train_steps:
                 try:
-                    batch = globalize(next(train_iter))
+                    batch = next(batch_iter)
                 except StopIteration:
                     _logger.info("input exhausted at step %d", step)
                     break
 
+        if not metrics_host:
+            # Loop never ran (restored checkpoint already at train_steps):
+            # still report the model's current loss instead of {}.
+            metrics_host = {
+                k: float(v) for k, v in eval_step(state, batch, train_rng).items()
+            }
         if core.model_dir:
             ckpt_lib.save_checkpoint(core.model_dir, step, state)
         if core.eval_input_fn:

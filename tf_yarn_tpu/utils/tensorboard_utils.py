@@ -37,7 +37,15 @@ def start_tf_board(kv: KVStore, task: str, model_dir: str) -> Optional[object]:
     """Start `tensorboard.program.TensorBoard` on a free port and broadcast
     its URL (reference: tensorboard.py:28-49). Returns the board object, or
     None when tensorboard isn't importable (the run proceeds without it)."""
-    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "cpp")
+    # The reference forces the C++ protobuf backend for event-parse speed
+    # (tensorboard.py:31-32); only do so when it's actually importable —
+    # images without it would otherwise fail the whole TB launch.
+    try:
+        from google.protobuf.pyext import _message  # noqa: F401
+
+        os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "cpp")
+    except ImportError:
+        pass
     try:
         from tensorboard.program import TensorBoard
 

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Run every example end-to-end on this machine (the role of the
+# reference's examples/run_examples.sh + run_pytorch_examples.sh real-
+# cluster matrices, shrunk to the local integration surface).
+set -e
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:$PYTHONPATH"
+
+for example in \
+    distributed_fn_example \
+    mnist_keras_example \
+    linear_classifier_example \
+    collective_allreduce_example \
+    llama_lora_example \
+    pytorch_example \
+    evaluator_sidecar_example
+do
+    echo "=== $example ==="
+    python "examples/$example.py"
+done
+echo "all examples passed"

@@ -1,0 +1,54 @@
+"""Packaging-layer tests (reference: cluster_pack shim, packaging.py)."""
+
+import os
+import zipfile
+
+from tf_yarn_tpu import packaging
+
+
+def test_zip_path_content_addressed(tmp_path):
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "a.py").write_text("print('a')")
+    sub = src / "pkg"
+    sub.mkdir()
+    (sub / "__init__.py").write_text("")
+    (src / "__pycache__").mkdir()
+    (src / "__pycache__" / "junk.pyc").write_text("x")
+
+    first = packaging.zip_path(str(src))
+    second = packaging.zip_path(str(src))
+    assert first == second  # same content -> same archive
+
+    with zipfile.ZipFile(first) as zf:
+        names = sorted(zf.namelist())
+    assert names == ["proj/a.py", "proj/pkg/__init__.py"]  # caches excluded
+
+    (src / "a.py").write_text("print('changed')")
+    third = packaging.zip_path(str(src))
+    assert third != first  # content change -> new name
+
+
+def test_upload_env_local_fs(tmp_path):
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "m.py").write_text("x = 1")
+    archive = packaging.zip_path(str(src))
+    remote = packaging.upload_env(archive, str(tmp_path / "shared"))
+    assert os.path.exists(remote)
+    with zipfile.ZipFile(remote) as zf:
+        assert "proj/m.py" in zf.namelist()
+
+
+def test_detect_packed_repo():
+    repo = packaging.detect_packed_repo()
+    assert os.path.isdir(os.path.join(repo, "tf_yarn_tpu"))
+
+
+def test_unpack_cmd_shape():
+    cmd = packaging.unpack_cmd("/shared/code.zip")
+    assert "PYTHONPATH" in cmd and "code.zip" in cmd
+
+
+def test_editable_requirements_returns_dict():
+    assert isinstance(packaging.get_editable_requirements(), dict)

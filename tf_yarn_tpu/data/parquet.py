@@ -89,15 +89,21 @@ class ParquetDataset:
                 global_idx += n
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # Buffers persist across epochs under repeat=True, so ranks whose
+        # per-epoch row count is below batch_size still make progress (and
+        # less of the tail is dropped overall).
+        buffers: Dict[str, List[np.ndarray]] = {}
+        buffered = 0
         while True:
-            buffers: Dict[str, List[np.ndarray]] = {}
-            buffered = 0
+            rows_this_epoch = 0
             for chunk in self._iter_rows():
                 if not buffers:
                     buffers = {k: [] for k in chunk}
                 for key, arr in chunk.items():
                     buffers[key].append(arr)
-                buffered += len(next(iter(chunk.values())))
+                n = len(next(iter(chunk.values())))
+                buffered += n
+                rows_this_epoch += n
                 while buffered >= self.batch_size:
                     merged = {k: np.concatenate(v) for k, v in buffers.items()}
                     batch = {k: v[: self.batch_size] for k, v in merged.items()}
@@ -106,6 +112,11 @@ class ParquetDataset:
                     }
                     buffered -= self.batch_size
                     yield batch
-            # tail (< batch_size) dropped: static shapes for XLA
             if not self.repeat:
+                # final tail (< batch_size) dropped: static shapes for XLA
                 return
+            if rows_this_epoch == 0:
+                raise ValueError(
+                    f"rank {self.rank}/{self.world_size} owns no rows in "
+                    f"{self.paths}; cannot repeat forever without data"
+                )

@@ -30,22 +30,48 @@ def prefetch(
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
+    stopped = threading.Event()
+
+    def _put(item) -> bool:
+        while not stopped.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer() -> None:
         try:
             for item in iterator:
-                q.put(place_fn(item) if place_fn else item)
+                if not _put(place_fn(item) if place_fn else item):
+                    return  # consumer gone: stop holding device batches
         except BaseException as exc:  # surface in consumer
-            q.put(("__prefetch_error__", exc))
+            _put(("__prefetch_error__", exc))
         finally:
-            q.put(_END)
+            _put(_END)
 
     thread = threading.Thread(target=producer, name="input-prefetch", daemon=True)
     thread.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
-            raise item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (
+                isinstance(item, tuple)
+                and len(item) == 2
+                and item[0] == "__prefetch_error__"
+            ):
+                raise item[1]
+            yield item
+    finally:
+        # Consumer done (train_steps reached / exception / generator
+        # closed): unblock the producer and drop staged device batches so
+        # they don't pin HBM through final eval/checkpoint.
+        stopped.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

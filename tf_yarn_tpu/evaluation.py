@@ -124,18 +124,27 @@ def continuous_eval(
             params = state["params"] if isinstance(state, dict) else state.params
             eval_state = TrainState(step=0, params=params, opt_state=())
 
+            # Count actually-consumed eval batches (the input may be
+            # shorter than eval_steps) so the health metrics stay honest.
+            consumed = {"n": 0}
+
+            def counted_input():
+                for batch in eval_input_fn():
+                    consumed["n"] += 1
+                    yield batch
+
             # Evaluator runs single-device (CPU): identity globalizer.
             metrics = evaluate(
                 eval_step,
                 eval_state,
-                eval_input_fn,
+                counted_input,
                 lambda b: b,
                 core.train_params.eval_steps,
                 rng,
             )
             elapsed = time.time() - t0
             awake_time += elapsed
-            nb_eval_steps += core.train_params.eval_steps
+            nb_eval_steps += consumed["n"]
             last_metrics = metrics
             done.add(step)
             last_new = time.time()
@@ -143,7 +152,7 @@ def continuous_eval(
             _logger.info("evaluated ckpt-%d: %s (%.1fs)", step, metrics, elapsed)
             for key, value in metrics.items():
                 mlflow.log_metric(f"eval_{key}_{n_try}", value, step=step)
-            broadcast_health(elapsed, core.train_params.eval_steps, step)
+            broadcast_health(elapsed, consumed["n"], step)
 
         if any(s >= final_step for s in done):
             _logger.info("final checkpoint (step %d) evaluated; stopping", final_step)

@@ -36,10 +36,15 @@ def binary_logistic_loss(model, params, batch, rng, train=True):
 
 def lm_loss(model, params, batch, rng, train=True):
     """Next-token cross-entropy for causal LMs: batch has "tokens"
-    [B, S] int32; loss over positions 0..S-2 predicting 1..S-1."""
+    [B, S] int32; loss over positions 0..S-2 predicting 1..S-1.
+    MoE models additionally contribute their sown load-balancing loss."""
     tokens = batch["tokens"]
-    logits = model.apply(
-        params, tokens, rngs={"dropout": rng}, deterministic=not train
+    logits, mod_vars = model.apply(
+        params,
+        tokens,
+        rngs={"dropout": rng},
+        deterministic=not train,
+        mutable=["intermediates"],
     )
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
@@ -49,7 +54,20 @@ def lm_loss(model, params, batch, rng, train=True):
         loss = (loss * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     else:
         loss = loss.mean()
-    return loss, {"perplexity": jnp.exp(loss)}
+    aux = {"perplexity": jnp.exp(loss)}
+    moe_weight = getattr(getattr(model, "config", None), "moe_aux_weight", 0.0)
+    moe_losses = [
+        jnp.sum(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            mod_vars.get("intermediates", {})
+        )[0]
+        if any("moe_aux_loss" in str(getattr(k, "key", "")) for k in path)
+    ]
+    if moe_losses and moe_weight:
+        moe_total = sum(moe_losses)
+        loss = loss + moe_weight * moe_total
+        aux["moe_aux_loss"] = moe_total
+    return loss, aux
 
 
 def synthetic_classification_iter(

@@ -1,0 +1,101 @@
+"""Mixture-of-Experts layer with expert parallelism (the `ep` mesh axis).
+
+GShard/Switch-style top-1 routing with capacity-bounded one-hot dispatch —
+the TPU MoE recipe: dispatch/combine are einsums (MXU work, static
+shapes), expert FFNs are batched matmuls with the expert axis annotated
+("expert" → ep in parallel.sharding.LOGICAL_RULES), so XLA places one
+expert group per ep shard and inserts the all-to-alls itself. No analog
+exists in the reference (SURVEY.md §2.5: expert parallelism — NO).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_yarn_tpu.models.transformer import EMBED, MLP, TransformerConfig, _partitioned
+
+EXPERT = "expert"
+
+
+class MoEMlp(nn.Module):
+    """Drop-in replacement for the dense SwiGLU block when
+    `config.moe_experts > 0`.
+
+    Returns the combined output; the Switch load-balancing loss is sown
+    into the "intermediates" collection as `moe_aux_loss` (collected by
+    models.common.lm_loss and scaled by `config.moe_aux_weight`).
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, d = x.shape
+        n_exp = cfg.moe_experts
+        tokens = x.reshape(b * s, d)
+        n_tokens = tokens.shape[0]
+        capacity = max(1, int(cfg.moe_capacity_factor * n_tokens / n_exp))
+
+        router = self.param(
+            "router",
+            _partitioned((EMBED, None))(nn.initializers.normal(stddev=0.02)),
+            (d, n_exp),
+            cfg.param_dtype,
+        )
+        # Router math in f32: tiny, numerically sensitive.
+        logits = jnp.einsum(
+            "td,de->te", tokens.astype(jnp.float32), router.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # top-1 (switch)
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+        # Capacity-bounded position of each token within its expert.
+        onehot = jax.nn.one_hot(expert_idx, n_exp, dtype=jnp.float32)  # [T,E]
+        position = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T,E], -1 elsewhere
+        in_capacity = (position >= 0) & (position < capacity)
+        onehot = onehot * in_capacity
+        gate = gate * jnp.sum(onehot, axis=-1)  # dropped tokens gate to 0
+
+        # dispatch [T, E, C]: token t -> slot (e, c).
+        pos_onehot = jax.nn.one_hot(
+            jnp.clip(position, 0, capacity - 1).astype(jnp.int32), capacity,
+            dtype=jnp.float32,
+        )  # [T, E, C]
+        dispatch = onehot[:, :, None] * pos_onehot
+
+        expert_inputs = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(cfg.dtype), tokens
+        )  # [E, C, D]
+
+        # Batched SwiGLU over the (ep-sharded) expert axis.
+        def expert_param(name, shape, axis_names):
+            return self.param(
+                name,
+                _partitioned((EXPERT, *axis_names))(nn.initializers.lecun_normal()),
+                (n_exp, *shape),
+                cfg.param_dtype,
+            )
+
+        w_gate = expert_param("w_gate", (d, cfg.d_ff), (EMBED, MLP))
+        w_up = expert_param("w_up", (d, cfg.d_ff), (EMBED, MLP))
+        w_down = expert_param("w_down", (cfg.d_ff, d), (MLP, EMBED))
+        h = nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_inputs, w_gate.astype(cfg.dtype))
+        ) * jnp.einsum("ecd,edf->ecf", expert_inputs, w_up.astype(cfg.dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
+
+        combined = jnp.einsum(
+            "tec,ecd->td", dispatch.astype(cfg.dtype), expert_out
+        ) * gate[:, None].astype(cfg.dtype)
+
+        # Switch aux loss: fraction-of-tokens x mean-router-prob per expert.
+        frac_tokens = jnp.mean(onehot, axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        aux_loss = n_exp * jnp.sum(frac_tokens * frac_probs)
+
+        self.sow("intermediates", "moe_aux_loss", aux_loss)
+        return combined.reshape(b, s, d).astype(cfg.dtype)

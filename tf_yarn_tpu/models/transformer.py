@@ -49,6 +49,14 @@ class TransformerConfig:
     remat: bool = True
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Mixture-of-Experts (0 = dense SwiGLU). Experts shard over the `ep`
+    # mesh axis (models/moe.py).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # Fused pallas RMSNorm (ops/rmsnorm.py). Opt-in: best on single-chip /
+    # shard_map paths; under pjit the XLA-fused norm already performs well.
+    fused_norms: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -110,6 +118,10 @@ class RMSNorm(nn.Module):
             "scale", _partitioned((None,))(nn.initializers.ones), (x.shape[-1],),
             cfg.param_dtype,
         )
+        if cfg.fused_norms:
+            from tf_yarn_tpu.ops.rmsnorm import rmsnorm
+
+            return rmsnorm(x, scale, eps=cfg.norm_eps).astype(cfg.dtype)
         x32 = x.astype(jnp.float32)
         norm = x32 * jax.lax.rsqrt(
             jnp.mean(x32 * x32, axis=-1, keepdims=True) + cfg.norm_eps
@@ -205,7 +217,12 @@ class Block(nn.Module):
     def __call__(self, x, positions):
         cfg = self.config
         x = x + Attention(cfg, name="attn")(RMSNorm(cfg, name="attn_norm")(x), positions)
-        x = x + SwiGLU(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(x))
+        if cfg.moe_experts > 0:
+            from tf_yarn_tpu.models.moe import MoEMlp
+
+            x = x + MoEMlp(cfg, name="moe")(RMSNorm(cfg, name="mlp_norm")(x))
+        else:
+            x = x + SwiGLU(cfg, name="mlp")(RMSNorm(cfg, name="mlp_norm")(x))
         return x
 
 
@@ -253,7 +270,9 @@ class Transformer(nn.Module):
         if cfg.scan_layers:
             scanned = nn.scan(
                 _ScanBody,
-                variable_axes={"params": 0},
+                # intermediates rides along stacked so sown values (MoE aux
+                # loss) survive the scan lift.
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,

@@ -73,9 +73,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         return m_new, l_new, acc_new
 
     if causal:
-        # kv blocks strictly after this q block are fully masked: skip them.
+        # kv blocks strictly after this q block are fully masked: skip
+        # them. Last useful block j satisfies j*block_k <= q_end, i.e.
+        # upper = ceil((q_block_idx+1)*block_q / block_k).
         upper = jnp.minimum(
-            num_kv_blocks, (q_block_idx + 1) * block_q // block_k + 1
+            num_kv_blocks,
+            ((q_block_idx + 1) * block_q + block_k - 1) // block_k,
         )
     else:
         upper = num_kv_blocks

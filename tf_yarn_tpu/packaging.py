@@ -1,0 +1,131 @@
+"""Environment & code packaging for multi-host placement.
+
+The reference delegates packaging to cluster_pack (pex/conda → HDFS;
+reference: tf_yarn/packaging.py:23-60 `zip_path` / `upload_env_to_hdfs` /
+`get_default_fs`). TPU slices are provisioned from images, so the common
+need shrinks to shipping the *project code* (and pinned requirements) to a
+filesystem every TPU VM can read (GCS bucket / NFS); `SshBackend`'s
+`pre_script_hook` then unpacks it before launching the task module.
+
+Kept API shape: `zip_path`, `upload_env`, `detect_packed_repo`, plus
+`get_editable_requirements` (reference: client.py:419,498-505 ships
+pip-editable projects alongside the pex).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import site
+import sys
+import tempfile
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+_logger = logging.getLogger(__name__)
+
+_EXCLUDE_DIRS = {".git", "__pycache__", ".pytest_cache", ".claude", "node_modules"}
+
+
+def zip_path(py_dir: str, include_base_name: bool = True) -> str:
+    """Zip a directory of Python code (reference: packaging.py:23-36).
+
+    Returns the path of a content-addressed zip in the temp dir (same
+    content → same name → cacheable on the far side).
+    """
+    py_dir = os.path.abspath(py_dir)
+    base = os.path.basename(py_dir)
+    entries: List[Tuple[str, str]] = []
+    for root, dirs, files in os.walk(py_dir):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        for name in sorted(files):
+            if name.endswith((".pyc", ".so.tmp")):
+                continue
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, py_dir)
+            if include_base_name:
+                rel = os.path.join(base, rel)
+            entries.append((full, rel))
+
+    digest = hashlib.sha256()
+    for full, rel in entries:
+        digest.update(rel.encode())
+        with open(full, "rb") as fh:
+            digest.update(fh.read())
+    out_path = os.path.join(
+        tempfile.gettempdir(), f"{base}-{digest.hexdigest()[:12]}.zip"
+    )
+    if not os.path.exists(out_path):
+        with zipfile.ZipFile(out_path, "w", zipfile.ZIP_DEFLATED) as zf:
+            for full, rel in entries:
+                zf.write(full, rel)
+        _logger.info("packed %s (%d files) -> %s", py_dir, len(entries), out_path)
+    return out_path
+
+
+def upload_env(
+    package_path: str, target_dir: str, filesystem=None
+) -> str:
+    """Copy a packed archive to `target_dir` on any pyarrow filesystem
+    (local path, gs://, hdfs:// — the upload_env_to_hdfs role,
+    reference: packaging.py:39-56). Returns the remote path."""
+    name = os.path.basename(package_path)
+    if filesystem is None:
+        from pyarrow import fs as pafs
+
+        filesystem, target_dir = pafs.FileSystem.from_uri(target_dir)
+    filesystem.create_dir(target_dir, recursive=True)
+    remote = f"{target_dir.rstrip('/')}/{name}"
+    with open(package_path, "rb") as src, filesystem.open_output_stream(
+        remote
+    ) as dst:
+        dst.write(src.read())
+    _logger.info("uploaded %s -> %s", package_path, remote)
+    return remote
+
+
+def get_editable_requirements() -> Dict[str, str]:
+    """pip-editable projects in this env: name -> source dir (reference:
+    cluster_pack's editable-requirements detection, client.py:498-505)."""
+    editable: Dict[str, str] = {}
+    for directory in site.getsitepackages() + [site.getusersitepackages()]:
+        if not os.path.isdir(directory):
+            continue
+        for entry in os.listdir(directory):
+            if entry.startswith("__editable__") and entry.endswith(".pth"):
+                name = entry[len("__editable__."):].split(".", 1)[0]
+                try:
+                    with open(os.path.join(directory, entry)) as fh:
+                        location = fh.read().strip().splitlines()[-1]
+                    if os.path.isdir(location):
+                        editable[name] = location
+                except OSError:
+                    continue
+    return editable
+
+
+def detect_packed_repo() -> Optional[str]:
+    """Directory of the running tf_yarn_tpu package (what to ship)."""
+    import tf_yarn_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(tf_yarn_tpu.__file__)))
+
+
+def unpack_cmd(remote_zip: str, dest: str = "~/.tpu_yarn_code") -> str:
+    """Shell one-liner for SshBackend.pre_script_hook: fetch + unzip +
+    prepend to PYTHONPATH on the TPU VM."""
+    return (
+        f"mkdir -p {dest} && python3 -c \"import zipfile,sys;"
+        f"zipfile.ZipFile('{remote_zip}').extractall('{dest}')\" && "
+        f"export PYTHONPATH={dest}:$PYTHONPATH"
+    )
+
+
+def python_env_description() -> Dict[str, str]:
+    """Env summary recorded with a run (version drift debugging)."""
+    return {
+        "python": sys.version.split()[0],
+        "executable": sys.executable,
+        "platform": sys.platform,
+    }

@@ -46,13 +46,25 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
     backend = experiment.backend or pt.collective_backend()
     os.environ.setdefault("MASTER_ADDR", params.master_addr)
     os.environ.setdefault("MASTER_PORT", str(params.master_port))
-    dist.init_process_group(
-        backend=backend, rank=params.rank, world_size=params.world_size
-    )
+    if backend == "xla":
+        # Registers the "xla" backend with torch.distributed; without this
+        # import init_process_group raises "Invalid backend".
+        import torch_xla.distributed.xla_backend  # noqa: F401
+
+        dist.init_process_group(
+            backend="xla",
+            init_method="xla://",
+        )
+    else:
+        dist.init_process_group(
+            backend=backend, rank=params.rank, world_size=params.world_size
+        )
     try:
         device = pt.get_device()
         model = experiment.model.to(device)
-        if params.world_size > 1 and backend != "xla":
+        if params.world_size > 1:
+            # DDP gradient sync on every backend — torch-xla supports DDP
+            # over its xla process group (gradients allreduce on ICI).
             from torch.nn.parallel import DistributedDataParallel
 
             model = DistributedDataParallel(

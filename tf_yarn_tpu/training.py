@@ -24,6 +24,7 @@ TPU-first design points:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Dict, NamedTuple, Optional
 
@@ -255,43 +256,66 @@ def train_and_evaluate(
         metrics_host: Dict[str, float] = {}
         from tf_yarn_tpu.data.prefetch import prefetch
 
+        # Tracing (SURVEY §5: reference has coarse timers only; the
+        # idiomatic TPU upgrade is a jax.profiler capture per host).
+        profile_dir = os.environ.get("TPU_YARN_PROFILE")
+        if profile_dir:
+            from jax import profiler as _profiler
+
+            _profiler.start_trace(profile_dir)
+
         batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
         batch = first_global
         step = resume_step
-        while step < params_cfg.train_steps:
-            state, metrics = train_step(state, batch, train_rng)
-            step += 1
-            if step % params_cfg.log_every_steps == 0 or step == params_cfg.train_steps:
-                metrics_host = {k: float(v) for k, v in metrics.items()}
-                hook.after_step(step, metrics_host, force=step == params_cfg.train_steps)
-                if tb_writer is not None:
-                    for key, value in metrics_host.items():
-                        tb_writer.add_scalar(f"train/{key}", value, step)
-            if (
-                params_cfg.checkpoint_every_steps
-                and step % params_cfg.checkpoint_every_steps == 0
-                and core.model_dir
-            ):
-                ckpt_lib.save_checkpoint(core.model_dir, step, state)
-            if (
-                params_cfg.eval_every_steps
-                and core.eval_input_fn
-                and step % params_cfg.eval_every_steps == 0
-            ):
-                eval_metrics = evaluate(
-                    eval_step, state, core.eval_input_fn, globalize,
-                    params_cfg.eval_steps, train_rng,
-                )
-                _logger.info("eval @ step %d: %s", step, eval_metrics)
-                if tb_writer is not None:
-                    for key, value in eval_metrics.items():
-                        tb_writer.add_scalar(f"eval/{key}", value, step)
-            if step < params_cfg.train_steps:
-                try:
-                    batch = next(batch_iter)
-                except StopIteration:
-                    _logger.info("input exhausted at step %d", step)
-                    break
+        try:
+            while step < params_cfg.train_steps:
+                state, metrics = train_step(state, batch, train_rng)
+                step += 1
+                if (
+                    step % params_cfg.log_every_steps == 0
+                    or step == params_cfg.train_steps
+                ):
+                    metrics_host = {k: float(v) for k, v in metrics.items()}
+                    hook.after_step(
+                        step, metrics_host, force=step == params_cfg.train_steps
+                    )
+                    if tb_writer is not None:
+                        for key, value in metrics_host.items():
+                            tb_writer.add_scalar(f"train/{key}", value, step)
+                if (
+                    params_cfg.checkpoint_every_steps
+                    and step % params_cfg.checkpoint_every_steps == 0
+                    and core.model_dir
+                ):
+                    ckpt_lib.save_checkpoint(core.model_dir, step, state)
+                if (
+                    params_cfg.eval_every_steps
+                    and core.eval_input_fn
+                    and step % params_cfg.eval_every_steps == 0
+                ):
+                    eval_metrics = evaluate(
+                        eval_step, state, core.eval_input_fn, globalize,
+                        params_cfg.eval_steps, train_rng,
+                    )
+                    _logger.info("eval @ step %d: %s", step, eval_metrics)
+                    if tb_writer is not None:
+                        for key, value in eval_metrics.items():
+                            tb_writer.add_scalar(f"eval/{key}", value, step)
+                if step < params_cfg.train_steps:
+                    try:
+                        batch = next(batch_iter)
+                    except StopIteration:
+                        _logger.info("input exhausted at step %d", step)
+                        break
+        finally:
+            # Unblock the prefetch producer and drop staged device batches.
+            batch_iter.close()
+            if profile_dir:
+                from jax import profiler as _profiler
+
+                jax.block_until_ready(state.params)
+                _profiler.stop_trace()
+                _logger.info("profiler trace written to %s", profile_dir)
 
         if not metrics_host:
             # Loop never ran (restored checkpoint already at train_steps):

@@ -1,0 +1,58 @@
+"""Real multi-process JAX training through the launcher.
+
+Two worker *processes* (separate interpreters), each owning one CPU
+device, joined by `jax.distributed` with the coordinator elected through
+the KV store — gradients allreduce across process boundaries for real.
+This is the coverage level SURVEY.md §4 says the reference never reaches
+(its CI mocks the cluster entirely).
+"""
+
+from tf_yarn_tpu.client import run_on_tpu
+from tf_yarn_tpu.topologies import TaskSpec
+
+
+def test_two_process_data_parallel_training(tmp_path):
+    out = str(tmp_path / "world")
+
+    def experiment_fn():
+        import optax
+
+        from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+        from tf_yarn_tpu.models import common, mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        def input_fn():
+            # Runs after jax.distributed.initialize: record the world this
+            # process actually sees, then feed the per-host batch (global
+            # batch 8 = 2 hosts x 4).
+            import jax
+
+            with open(f"{out}-{jax.process_index()}", "w") as fh:
+                fh.write(f"procs={jax.process_count()} devices={jax.device_count()}")
+            return common.synthetic_classification_iter(4, 16, 4)
+
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=input_fn,
+            train_params=TrainParams(train_steps=6, log_every_steps=2),
+            mesh_spec=MeshSpec(fsdp=2),
+        )
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=2)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        poll_every_secs=0.3,
+    )
+    assert metrics.total_training_duration is not None
+    assert set(metrics.container_duration) == {"worker:0", "worker:1"}
+    for rank in (0, 1):
+        with open(f"{out}-{rank}") as fh:
+            content = fh.read()
+        # Two real processes in one jax.distributed world (device count
+        # depends on inherited virtual-device flags; >= one per process).
+        assert "procs=2" in content
+        devices = int(content.split("devices=")[1])
+        assert devices >= 2

@@ -35,10 +35,14 @@ def _rmsnorm_forward(x, scale, eps: float, block_rows: int, interpret: bool):
     rows = 1
     for dim in orig_shape[:-1]:
         rows *= dim
+    import math
+
     x2 = x.reshape(rows, d)
     block_rows = min(block_rows, rows)
     if rows % block_rows:
-        block_rows = 1  # always divides; degenerate but correct
+        # Largest divisor <= block_rows keeps the grid small for
+        # almost-divisible shapes (vs collapsing straight to 1 row/step).
+        block_rows = math.gcd(rows, block_rows)
     out = pl.pallas_call(
         functools.partial(_rmsnorm_kernel, eps=eps),
         grid=(rows // block_rows,),

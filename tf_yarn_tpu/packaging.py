@@ -38,7 +38,8 @@ def zip_path(py_dir: str, include_base_name: bool = True) -> str:
     base = os.path.basename(py_dir)
     entries: List[Tuple[str, str]] = []
     for root, dirs, files in os.walk(py_dir):
-        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        # Sorted traversal: the content digest must not depend on inode order.
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
         for name in sorted(files):
             if name.endswith((".pyc", ".so.tmp")):
                 continue
@@ -87,19 +88,30 @@ def upload_env(
 
 def get_editable_requirements() -> Dict[str, str]:
     """pip-editable projects in this env: name -> source dir (reference:
-    cluster_pack's editable-requirements detection, client.py:498-505)."""
+    cluster_pack's editable-requirements detection, client.py:498-505).
+
+    Best-effort: covers path-style `__editable__.<name>-<ver>.pth` files.
+    PEP-660 finder-style editables (a pth containing an `import ..._finder`
+    line, no path) carry no directory to ship and are skipped.
+    """
     editable: Dict[str, str] = {}
     for directory in site.getsitepackages() + [site.getusersitepackages()]:
         if not os.path.isdir(directory):
             continue
         for entry in os.listdir(directory):
             if entry.startswith("__editable__") and entry.endswith(".pth"):
-                name = entry[len("__editable__."):].split(".", 1)[0]
+                # "__editable__.mypkg-1.0.0.pth" -> "mypkg"
+                stem = entry[len("__editable__."):-len(".pth")]
+                name = stem.split("-", 1)[0]
                 try:
                     with open(os.path.join(directory, entry)) as fh:
-                        location = fh.read().strip().splitlines()[-1]
-                    if os.path.isdir(location):
-                        editable[name] = location
+                        lines = [
+                            line.strip()
+                            for line in fh.read().splitlines()
+                            if line.strip() and not line.startswith("import ")
+                        ]
+                    if lines and os.path.isdir(lines[-1]):
+                        editable[name] = lines[-1]
                 except OSError:
                     continue
     return editable

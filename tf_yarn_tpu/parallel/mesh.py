@@ -117,7 +117,33 @@ def select_devices(n: Optional[int] = None, platform: Optional[str] = None):
             raise ValueError(
                 f"need {n} devices, have {len(devices)} ({platform or 'default'})"
             )
-        devices = devices[:n]
+        n_proc = jax.process_count()
+        if n_proc > 1 and n < len(devices):
+            # Multi-host: the mesh must span every process (a mesh with no
+            # addressable device on some host cannot place that host's
+            # data). Take n/n_proc of each process's devices, in process
+            # order.
+            if n % n_proc:
+                raise ValueError(
+                    f"{n} mesh devices cannot spread evenly over "
+                    f"{n_proc} processes"
+                )
+            per_proc = n // n_proc
+            by_proc: dict = {}
+            for device in devices:
+                by_proc.setdefault(device.process_index, []).append(device)
+            devices = [
+                d
+                for pid in sorted(by_proc)
+                for d in by_proc[pid][:per_proc]
+            ]
+            if len(devices) != n:
+                raise ValueError(
+                    f"processes contribute unevenly: wanted {per_proc} "
+                    f"devices from each of {n_proc} processes"
+                )
+        else:
+            devices = devices[:n]
     return devices
 
 

@@ -94,17 +94,24 @@ def pipeline_apply(
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = axis_sizes.get(AXIS_PP, 1)
     if n_stages == 1:
-        params = jax.tree_util.tree_map(lambda p: p, stage_params)
-
         def sequential(x):
             n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
             for i in range(n):
                 x = stage_fn(
-                    jax.tree_util.tree_map(lambda p: p[i], params), x
+                    jax.tree_util.tree_map(lambda p: p[i], stage_params), x
                 )
             return x
 
         return sequential(x)
+
+    leading = {
+        leaf.shape[0] for leaf in jax.tree_util.tree_leaves(stage_params)
+    }
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading dims {sorted(leading)} must all equal the "
+            f"pp mesh axis size {n_stages} (one stage slice per pp shard)"
+        )
 
     batch = x.shape[0]
     if batch % num_microbatches:

@@ -50,10 +50,17 @@ def _maybe_init_jax_distributed(runtime: _bootstrap.TaskRuntime) -> None:
     process_id = [ti.key for ti in primaries].index(runtime.task_key)
     import jax
 
+    platform = os.environ.get("TPU_YARN_PLATFORM")
+    if platform:  # narrow backend selection before any distributed setup
+        jax.config.update("jax_platforms", platform)
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=len(primaries),
         process_id=process_id,
+    )
+    _logger.info(
+        "jax.distributed up: process %d/%d, coordinator %s",
+        process_id, len(primaries), addr,
     )
 
 

@@ -36,9 +36,11 @@ def bench_flagship_train(steps: int = 20, warmup: int = 3):
     _log(f"benchmarking on {len(devices)} x {devices[0].device_kind}")
 
     if on_tpu:
+        # remat off: this config's activations fit one chip's HBM, so
+        # recompute would only burn MXU cycles.
         config = TransformerConfig(
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
-            n_kv_heads=8, d_ff=4096, max_seq_len=2048,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
         )
         batch_size, seq_len = 8, 1024
     else:  # CPU smoke fallback so the bench always emits a line

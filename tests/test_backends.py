@@ -1,0 +1,94 @@
+"""Backend tests: LocalBackend status transitions, SshBackend command
+construction (no ssh connection needed)."""
+
+import time
+from unittest import mock
+
+from tf_yarn_tpu.backends import (
+    KILLED,
+    RUNNING,
+    SUCCEEDED,
+    LocalBackend,
+    ServiceSpec,
+    SshBackend,
+    TpuVmHost,
+)
+
+
+def test_local_backend_killed_status(tmp_path):
+    backend = LocalBackend()
+    handle = backend.launch(
+        {"worker": ServiceSpec(module="tf_yarn_tpu.tasks._spin", instances=1)},
+        str(tmp_path),
+    )
+    assert handle.status() == RUNNING
+    handle.kill()
+    deadline = time.time() + 15
+    while handle.status() == RUNNING and time.time() < deadline:
+        time.sleep(0.2)
+    assert handle.status() == KILLED
+
+
+def test_local_backend_success_status(tmp_path):
+    backend = LocalBackend()
+    handle = backend.launch(
+        {"worker": ServiceSpec(module="platform", instances=2)},  # exits 0
+        str(tmp_path),
+    )
+    deadline = time.time() + 30
+    while handle.status() == RUNNING and time.time() < deadline:
+        time.sleep(0.2)
+    assert handle.status() == SUCCEEDED
+    logs = handle.logs()
+    assert set(logs) == {"worker:0", "worker:1"}
+
+
+def test_ssh_backend_command_construction(tmp_path):
+    hosts = [TpuVmHost("tpu-vm-0", 0), TpuVmHost("tpu-vm-1", 1)]
+    backend = SshBackend(hosts, remote_prefix="/opt/code")
+    captured = []
+
+    def fake_popen(cmd, **kwargs):
+        captured.append(cmd)
+        proc = mock.Mock()
+        proc.poll.return_value = 0
+        proc.returncode = 0
+        proc.pid = 1234
+        return proc
+
+    with mock.patch("subprocess.Popen", side_effect=fake_popen):
+        backend.launch(
+            {
+                "chief": ServiceSpec(
+                    module="tf_yarn_tpu.tasks.worker",
+                    instances=1,
+                    env={"TPU_YARN_COORDINATOR": "10.0.0.1:9999"},
+                ),
+                "worker": ServiceSpec(
+                    module="tf_yarn_tpu.tasks.worker", instances=1, env={}
+                ),
+            },
+            str(tmp_path),
+        )
+    assert len(captured) == 2
+    chief_cmd = captured[0]
+    assert chief_cmd[0] == "ssh"
+    assert chief_cmd[-2] == "tpu-vm-0"
+    remote = chief_cmd[-1]
+    assert "cd /opt/code" in remote
+    assert "TPU_YARN_TASK=chief:0" in remote
+    assert "TPU_YARN_COORDINATOR=10.0.0.1:9999" in remote
+    assert "-m tf_yarn_tpu.tasks.worker" in remote
+    # chief occupies host 0, worker host 1 (slice ordering).
+    assert captured[1][-2] == "tpu-vm-1"
+    assert "TPU_YARN_TASK=worker:0" in captured[1][-1]
+
+
+def test_ssh_backend_too_many_tasks():
+    backend = SshBackend([TpuVmHost("h", 0)])
+    import pytest
+
+    with pytest.raises(ValueError, match="TPU VM hosts"):
+        backend.launch(
+            {"worker": ServiceSpec(module="m", instances=2)}, "/tmp"
+        )

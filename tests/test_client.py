@@ -101,6 +101,34 @@ def test_run_on_tpu_retry_then_success(tmp_path):
     assert metrics is not None
 
 
+def test_run_on_tpu_ships_files_into_task_cwd(tmp_path):
+    payload = tmp_path / "config.json"
+    payload.write_text('{"lr": 0.1}')
+    out = str(tmp_path / "seen")
+
+    def experiment_fn():
+        def run(params):
+            import os
+
+            with open("config.json") as fh:  # shipped into the task cwd
+                content = fh.read()
+            with open(out, "w") as fh:
+                fh.write(f"{os.getcwd()}|{content}")
+
+        return run
+
+    run_on_tpu(
+        experiment_fn,
+        _worker_specs(instances=1),
+        custom_task_module=DISTRIBUTED,
+        files={"config.json": str(payload)},
+        poll_every_secs=0.2,
+    )
+    cwd, content = open(out).read().split("|")
+    assert content == '{"lr": 0.1}'
+    assert "worker-0-files" in cwd
+
+
 def test_get_safe_experiment_fn():
     fn = get_safe_experiment_fn("os.getcwd")
     assert fn() == os.getcwd()

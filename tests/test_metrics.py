@@ -1,0 +1,100 @@
+"""Metrics-layer tests: event folding, evaluator-metric thresholds,
+one-shot logging (reference: tests/test_evaluator_metrics.py +
+client-side _handle_events coverage)."""
+
+import time
+
+from tf_yarn_tpu import event
+from tf_yarn_tpu.coordination import InProcessKV
+from tf_yarn_tpu.topologies import TaskKey
+from tf_yarn_tpu.utils.evaluator_metrics import EvaluatorMetricsLogger
+from tf_yarn_tpu.utils.metrics import OneShotMetricsLogger, handle_events
+
+
+def _timed_task(kv, task, start, stop, train_start=None, train_stop=None,
+                failed=False):
+    event.broadcast(kv, f"{task}/{event.CONTAINER_START_TIME}", str(start))
+    event.broadcast(kv, f"{task}/{event.CONTAINER_STOP_TIME}", str(stop))
+    if train_start is not None:
+        event.broadcast(kv, f"{task}/{event.TRAIN_EVAL_START_TIME}", str(train_start))
+        event.broadcast(kv, f"{task}/{event.TRAIN_EVAL_STOP_TIME}", str(train_stop))
+    event.start_event(kv, task)
+    if failed:
+        event.broadcast(kv, f"{task}/{event.STOP}", "Traceback: boom")
+    else:
+        event.stop_event(kv, task)
+
+
+def test_handle_events_full_run():
+    kv = InProcessKV()
+    t0 = time.time()
+    _timed_task(kv, "chief:0", t0, t0 + 100, t0 + 10, t0 + 90)
+    _timed_task(kv, "worker:0", t0 + 1, t0 + 99, t0 + 12, t0 + 95)
+    _timed_task(kv, "evaluator:0", t0 + 2, t0 + 98, t0 + 20, t0 + 97)
+    metrics, outcomes = handle_events(
+        kv, ["chief:0", "worker:0", "evaluator:0"]
+    )
+    # train duration = min start (10) -> max stop (95) over chief+workers.
+    assert abs(metrics.total_training_duration - 85) < 1e-6
+    assert abs(metrics.total_eval_duration - 77) < 1e-6
+    assert abs(metrics.container_duration["chief:0"] - 100) < 1e-6
+    assert all(o.status == "SUCCEEDED" for o in outcomes.values())
+
+
+def test_handle_events_statuses():
+    kv = InProcessKV()
+    t0 = time.time()
+    _timed_task(kv, "worker:0", t0, t0 + 5, failed=True)
+    # worker:1 started (has a start-time) but never stopped -> KILLED.
+    event.broadcast(kv, f"worker:1/{event.CONTAINER_START_TIME}", str(t0))
+    # worker:2 has no events at all -> REQUESTED.
+    metrics, outcomes = handle_events(kv, ["worker:0", "worker:1", "worker:2"])
+    assert outcomes["worker:0"].status == "FAILED"
+    assert "boom" in outcomes["worker:0"].exception
+    assert outcomes["worker:1"].status == "KILLED"
+    assert outcomes["worker:2"].status == "REQUESTED"
+    assert metrics.total_training_duration is None
+
+
+def test_evaluator_metrics_logger_thresholds(caplog):
+    kv = InProcessKV()
+    task = TaskKey("evaluator", 0)
+    logger = EvaluatorMetricsLogger(
+        [task],
+        kv,
+        log_thresholds={"awake_time_ratio": (0.5, 1.0)},
+    )
+    kv.put_str("evaluator:0/awake_time_ratio", "0.25")  # below threshold
+    kv.put_str("evaluator:0/nb_eval_steps", "12")  # unthresholded
+    import logging
+
+    with caplog.at_level(logging.INFO):
+        logger.log()
+    messages = " ".join(r.message for r in caplog.records)
+    assert "Awake/idle ratio" not in messages  # filtered out
+    assert "Number of evaluation steps done" in messages
+
+    # Unchanged values are not re-logged.
+    caplog.clear()
+    with caplog.at_level(logging.INFO):
+        logger.log()
+    assert not caplog.records
+
+    # A changed value passing the threshold is logged.
+    kv.put_str("evaluator:0/awake_time_ratio", "0.75")
+    with caplog.at_level(logging.INFO):
+        logger.log()
+    assert any("Awake/idle ratio" in r.message for r in caplog.records)
+
+
+def test_one_shot_metrics_logger():
+    kv = InProcessKV()
+    logger = OneShotMetricsLogger(
+        kv, [("tensorboard:0/url", "tensorboard URL")], n_try=0
+    )
+    logger.log()  # nothing published yet -> stays pending
+    assert logger._pending
+    kv.put_str("tensorboard:0/url", "http://host:6006")
+    logger.log()
+    assert not logger._pending
+    logger.log()  # idempotent once consumed

@@ -56,6 +56,9 @@ class ServiceSpec:
     env: Dict[str, str] = field(default_factory=dict)
     nb_proc: int = 1
     pre_script_hook: str = ""
+    # Extra files shipped into each task's working directory, name -> local
+    # path (the reference's `files` upload, client.py:337-344).
+    files: Dict[str, str] = field(default_factory=dict)
 
 
 class ClusterHandle(ABC):
@@ -170,6 +173,29 @@ class LocalBackend(SliceBackend):
                 env = dict(os.environ)
                 env.update(spec.env)
                 env[constants.ENV_TASK_KEY] = key.to_kv_str()
+                workdir = None
+                if spec.files:
+                    # Each task gets a working dir with the shipped files
+                    # (container-cwd semantics of the reference's uploads).
+                    import shutil
+
+                    workdir = os.path.join(
+                        log_dir, f"{task_type}-{task_id}-files"
+                    )
+                    os.makedirs(workdir, exist_ok=True)
+                    for name, src in spec.files.items():
+                        dst = os.path.join(workdir, name)
+                        os.makedirs(os.path.dirname(dst), exist_ok=True)
+                        if os.path.isdir(src):
+                            shutil.copytree(src, dst, dirs_exist_ok=True)
+                        else:
+                            shutil.copy(src, dst)
+                    # cwd moves to the workdir; keep the driver's cwd
+                    # importable (python -m relied on it for source
+                    # checkouts where the package isn't installed).
+                    env["PYTHONPATH"] = (
+                        os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+                    )
                 log_path = os.path.join(log_dir, f"{task_type}-{task_id}.log")
                 log_files[key] = log_path
                 log_file = open(log_path, "wb")
@@ -179,12 +205,17 @@ class LocalBackend(SliceBackend):
                     procs[key] = subprocess.Popen(
                         ["/bin/sh", "-c", shell],
                         env=env,
+                        cwd=workdir,
                         stdout=log_file,
                         stderr=subprocess.STDOUT,
                     )
                 else:
                     procs[key] = subprocess.Popen(
-                        cmd, env=env, stdout=log_file, stderr=subprocess.STDOUT
+                        cmd,
+                        env=env,
+                        cwd=workdir,
+                        stdout=log_file,
+                        stderr=subprocess.STDOUT,
                     )
                 log_file.close()
                 _logger.info("launched %s as pid %d", key, procs[key].pid)
@@ -240,6 +271,12 @@ class SshBackend(SliceBackend):
         procs: Dict[TaskKey, subprocess.Popen] = {}
         log_files: Dict[TaskKey, str] = {}
         for host, (key, spec) in zip(self._hosts, assignments):
+            if spec.files:
+                raise NotImplementedError(
+                    "files= shipping over SshBackend is not implemented yet; "
+                    "stage files on a shared filesystem (see packaging.upload_env "
+                    "+ pre_script_hook) instead"
+                )
             env_exports = " ".join(
                 f"{k}={shlex.quote(v)}"
                 for k, v in {**spec.env, constants.ENV_TASK_KEY: key.to_kv_str()}.items()

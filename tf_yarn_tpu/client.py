@@ -100,6 +100,7 @@ def _setup_task_env(
     env: Dict[str, str],
     custom_task_module: Optional[str],
     pre_script_hook: str,
+    files: Optional[Dict[str, str]] = None,
 ) -> Dict[str, ServiceSpec]:
     """Build one ServiceSpec per task type (reference: client.py:108-133
     `_setup_task_env` + 210-240 service construction)."""
@@ -139,6 +140,7 @@ def _setup_task_env(
             env=task_env,
             nb_proc=spec.nb_proc_per_worker,
             pre_script_hook=pre_script_hook,
+            files=dict(files or {}),
         )
     return services
 
@@ -173,6 +175,7 @@ def _setup_cluster(
     pre_script_hook: str,
     name: str,
     coordinator_bind: str,
+    files: Optional[Dict[str, str]] = None,
 ) -> SliceCluster:
     log_dir = tempfile.mkdtemp(prefix=f"{name}-logs-")
     server = start_best_server(host=coordinator_bind)
@@ -186,6 +189,7 @@ def _setup_cluster(
             env,
             custom_task_module,
             pre_script_hook,
+            files,
         )
         cluster_tasks = _setup_cluster_spec(task_specs, kv)
         handle = backend.launch(services, log_dir)
@@ -303,6 +307,7 @@ def run_on_tpu(
     backend: Optional[SliceBackend] = None,
     custom_task_module: Optional[str] = None,
     env: Optional[Dict[str, str]] = None,
+    files: Optional[Dict[str, str]] = None,
     pre_script_hook: str = "",
     nb_retries: int = 0,
     poll_every_secs: float = 0.5,
@@ -337,6 +342,7 @@ def run_on_tpu(
                 pre_script_hook,
                 name,
                 coordinator_bind,
+                files,
             )
             return _execute_and_await_termination(
                 cluster,

@@ -276,7 +276,10 @@ class Transformer(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: None},
+                # Logical name for the stacked-layer axis: maps to the pp
+                # mesh axis (parallel.sharding.LOGICAL_RULES), so a pp>1
+                # mesh shards whole layers across pipeline stages.
+                metadata_params={nn.PARTITION_NAME: "layers"},
             )
             x, _ = scanned(cfg, name="layers")(x, positions)
         else:

@@ -27,6 +27,7 @@ from tf_yarn_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_EP,
     AXIS_FSDP,
+    AXIS_PP,
     AXIS_SP,
     AXIS_TP,
     BATCH_AXES,
@@ -47,6 +48,10 @@ LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("vocab", AXIS_TP),
     ("expert", AXIS_EP),
     ("conv_out", AXIS_FSDP),
+    # Scan-stacked layer axis: shards over pp when a pipeline axis exists
+    # (naive layer-sharded pipelining — XLA moves activations between
+    # stages; the overlapped GPipe schedule lives in parallel/pipeline.py).
+    ("layers", AXIS_PP),
     ("stage", None),
 )
 

@@ -1,0 +1,7 @@
+"""Test-support task: spin until killed (used to exercise the KILLED
+status path of backends without a real long training job)."""
+
+import time
+
+if __name__ == "__main__":
+    time.sleep(120)

@@ -5,7 +5,8 @@ Prints ONE JSON line:
 
 The reference publishes no numbers (BASELINE.md: "None"), so vs_baseline
 compares against the value recorded in BENCH_BASELINE.json when present
-(our own previous round), else 1.0.
+(our own previous round), else 1.0. The full per-config suite lives in
+benchmarks/run.py.
 """
 
 from __future__ import annotations
@@ -13,23 +14,21 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 
 def _log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
-def bench_flagship_train(steps: int = 20, warmup: int = 3):
-    import jax
+def bench_flagship_train():
     import numpy as np
-    import optax
 
+    from tf_yarn_tpu.benchmark import measure_throughput
     from tf_yarn_tpu.models import common
     from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
-    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
-    from tf_yarn_tpu.parallel.sharding import tree_shardings, unbox_params
-    from tf_yarn_tpu.training import TrainState, build_train_step
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    import optax
 
     devices = select_devices()
     on_tpu = devices[0].platform == "tpu"
@@ -42,59 +41,31 @@ def bench_flagship_train(steps: int = 20, warmup: int = 3):
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
         )
-        batch_size, seq_len = 8, 1024
+        batch_size, seq_len, steps, warmup = 8, 1024, 20, 3
     else:  # CPU smoke fallback so the bench always emits a line
         config = TransformerConfig.tiny()
-        batch_size, seq_len = 8, 64
-        steps, warmup = 5, 1
+        batch_size, seq_len, steps, warmup = 8, 64, 5, 1
 
-    spec = MeshSpec.auto(len(devices))
-    mesh = build_mesh(spec, devices)
     model = Transformer(config)
-    optimizer = optax.adamw(1e-4)
-    rng = jax.random.PRNGKey(0)
     tokens = np.random.RandomState(0).randint(
         0, config.vocab_size, (batch_size, seq_len), dtype=np.int32
     )
-
-    with mesh:
-        def init_state(rng, tokens):
-            variables = model.init(rng, tokens)
-            params = unbox_params(variables)
-            return TrainState(np.int32(0), params, optimizer.init(params))
-
-        def init_boxed(rng, tokens):
-            variables = model.init(rng, tokens)
-            return TrainState(np.int32(0), variables, optimizer.init(variables))
-
-        abstract = jax.eval_shape(init_boxed, rng, tokens)
-        shardings = tree_shardings(mesh, abstract)
-        state = jax.jit(init_state, out_shardings=shardings)(rng, tokens)
-        step_fn = jax.jit(
-            build_train_step(model, common.lm_loss, optimizer),
-            donate_argnums=(0,),
-            out_shardings=(shardings, None),
-        )
-        batch = {"tokens": jax.device_put(tokens)}
-
-        t0 = time.time()
-        for _ in range(warmup):
-            state, metrics = step_fn(state, batch, rng)
-        jax.block_until_ready(state.params)
-        _log(f"warmup ({warmup} steps incl. compile): {time.time() - t0:.1f}s")
-
-        t0 = time.time()
-        for _ in range(steps):
-            state, metrics = step_fn(state, batch, rng)
-        jax.block_until_ready(state.params)
-        elapsed = time.time() - t0
-
-    samples_per_sec = steps * batch_size / elapsed
-    per_chip = samples_per_sec / len(devices)
-    _log(f"{steps} steps in {elapsed:.2f}s; loss={float(metrics['loss']):.3f}")
+    stats = measure_throughput(
+        model,
+        common.lm_loss,
+        optax.adamw(1e-4),
+        {"tokens": tokens},
+        steps=steps,
+        warmup=warmup,
+        devices=devices,
+    )
+    _log(
+        f"compile+warmup {stats['compile_plus_warmup_s']:.1f}s; "
+        f"step {stats['step_time_ms']:.1f}ms; loss={stats['final_loss']:.3f}"
+    )
     return {
         "metric": "flagship_train_samples_per_sec_per_chip",
-        "value": round(per_chip, 3),
+        "value": round(stats["samples_per_sec_per_chip"], 3),
         "unit": f"samples/sec/chip (d_model={config.d_model}, "
         f"layers={config.n_layers}, seq={seq_len}, bf16, "
         f"{'tpu' if on_tpu else 'cpu-fallback'})",

@@ -1,0 +1,208 @@
+"""BASELINE.json benchmark suite: one JSON line per config.
+
+The five configs BASELINE.md tracks (Keras-MNIST-dense, LinearClassifier
+clicks, BERT-base, ResNet-50, Llama-LoRA) plus the ICI allreduce
+microbench. Sizes are TPU-realistic when a TPU is present and tiny on the
+CPU rig (`--cpu` forces the latter).
+
+    python benchmarks/run.py                 # all configs
+    python benchmarks/run.py bert_base       # one config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    return select_devices()[0].platform == "tpu"
+
+
+def bench_mnist_dense(tpu: bool):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.mnist import DenseClassifier
+
+    batch = 512 if tpu else 64
+    rng = np.random.RandomState(0)
+    return measure_throughput(
+        DenseClassifier(),
+        common.classification_loss,
+        optax.adam(1e-3),
+        {
+            "x": rng.randn(batch, 784).astype(np.float32),
+            "y": rng.randint(0, 10, batch).astype(np.int32),
+        },
+    )
+
+
+def bench_linear_clicks(tpu: bool):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.linear import HashedLinearClassifier, LinearConfig
+
+    config = LinearConfig(n_buckets=2**20 if tpu else 2**12, n_features=26)
+    batch = 4096 if tpu else 256
+    rng = np.random.RandomState(0)
+    model = HashedLinearClassifier(config)
+    return measure_throughput(
+        model,
+        common.binary_logistic_loss,
+        optax.adagrad(0.05),
+        {
+            "x": rng.randint(0, config.n_buckets, (batch, 26)).astype(np.int32),
+            "y": rng.randint(0, 2, batch).astype(np.int32),
+        },
+    )
+
+
+def bench_bert_base(tpu: bool):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import bert
+
+    config = bert.BertConfig.base() if tpu else bert.BertConfig.tiny()
+    batch, seq = (16, 128) if tpu else (8, 32)
+    rng = np.random.RandomState(0)
+    model = bert.BertClassifier(config)
+
+    def loss_fn(model, params, batch, rng_, train=True):
+        import jax.numpy as jnp
+
+        logits = model.apply(
+            params, batch["x"], rngs={"dropout": rng_}, deterministic=not train
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+        return loss, {"accuracy": jnp.mean(jnp.argmax(logits, -1) == batch["y"])}
+
+    return measure_throughput(
+        model,
+        loss_fn,
+        optax.adamw(2e-5),
+        {
+            "x": rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32),
+            "y": rng.randint(0, config.num_classes, batch).astype(np.int32),
+        },
+        init_fn=lambda r, b: model.init(r, b["x"]),
+        steps=10 if tpu else 5,
+    )
+
+
+def bench_resnet50(tpu: bool):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common, resnet
+
+    config = resnet.ResNetConfig.resnet50() if tpu else resnet.ResNetConfig.tiny()
+    batch, size = (64, 224) if tpu else (8, 32)
+    rng = np.random.RandomState(0)
+    model = resnet.ResNet(config)
+    return measure_throughput(
+        model,
+        common.classification_loss,
+        optax.sgd(0.1, momentum=0.9),
+        {
+            "x": rng.randn(batch, size, size, 3).astype(np.float32),
+            "y": rng.randint(0, config.num_classes, batch).astype(np.int32),
+        },
+        steps=10 if tpu else 5,
+    )
+
+
+def bench_llama_lora(tpu: bool):
+    import numpy as np
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        make_lora_optimizer,
+    )
+
+    if tpu:
+        # Largest decoder that fits one v5e chip comfortably for a bench.
+        config = TransformerConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=8, d_ff=5632, max_seq_len=2048, lora_rank=16,
+            remat=False,
+        )
+        batch, seq = 4, 1024
+    else:
+        config = TransformerConfig.tiny(lora_rank=4)
+        batch, seq = 8, 32
+    rng = np.random.RandomState(0)
+    model = Transformer(config)
+    return measure_throughput(
+        model,
+        common.lm_loss,
+        make_lora_optimizer(1e-4),
+        {"tokens": rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)},
+        init_fn=lambda r, b: model.init(r, b["tokens"]),
+        steps=10 if tpu else 5,
+    )
+
+
+def bench_ici_allreduce(tpu: bool):
+    from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    return allreduce_bandwidth(
+        size_mb=64.0 if tpu else 2.0, iters=10, devices=select_devices()
+    )
+
+
+CONFIGS = {
+    "mnist_dense": bench_mnist_dense,
+    "linear_clicks": bench_linear_clicks,
+    "bert_base": bench_bert_base,
+    "resnet50": bench_resnet50,
+    "llama_lora": bench_llama_lora,
+    "ici_allreduce": bench_ici_allreduce,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("configs", nargs="*", default=list(CONFIGS))
+    parser.add_argument("--cpu", action="store_true", help="force tiny CPU shapes")
+    args = parser.parse_args()
+    if args.cpu:
+        os.environ.setdefault("TPU_YARN_PLATFORM", "cpu")
+    unknown = [name for name in args.configs if name not in CONFIGS]
+    if unknown:
+        parser.error(
+            f"unknown config(s) {unknown}; choose from {sorted(CONFIGS)}"
+        )
+    tpu = (not args.cpu) and _on_tpu()
+    for name in args.configs:
+        result = CONFIGS[name](tpu)
+        print(json.dumps({"config": name, "tpu": tpu, **{
+            k: round(v, 4) if isinstance(v, float) else v
+            for k, v in result.items()
+        }}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,55 @@ def test_eval_loop(tmp_path):
     assert np.isfinite(metrics["eval_loss"])
 
 
+def test_estimator_train_and_evaluate_methods(tmp_path):
+    import optax
+
+    from tf_yarn_tpu.experiment import Estimator
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.mnist import DenseClassifier
+    from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+    estimator = Estimator(
+        model=DenseClassifier(hidden_sizes=(16,), num_classes=4),
+        loss_fn=common.classification_loss,
+        optimizer=optax.adam(1e-2),
+        model_dir=str(tmp_path),
+        mesh_spec=MeshSpec(fsdp=8),
+    )
+    metrics = estimator.train(
+        lambda: mnist.common.synthetic_classification_iter(64, 32, 4),
+        max_steps=15,
+    )
+    assert np.isfinite(metrics["loss"])
+    eval_metrics = estimator.evaluate(
+        lambda: mnist.common.synthetic_classification_iter(64, 32, 4, seed=9),
+        steps=3,
+    )
+    assert np.isfinite(eval_metrics["loss"])
+
+
+def test_run_on_tpu_timeout_kills_hung_cluster(tmp_path):
+    from tf_yarn_tpu.client import RunFailed, run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    def experiment_fn():
+        def run(params):
+            import time
+
+            time.sleep(60)  # "hung" task
+
+        return run
+
+    with pytest.raises(RunFailed, match="KILLED"):
+        run_on_tpu(
+            experiment_fn,
+            {"worker": TaskSpec(instances=1)},
+            custom_task_module="tf_yarn_tpu.tasks.distributed",
+            poll_every_secs=0.2,
+            timeout_secs=6.0,
+        )
+
+
 def test_run_on_tpu_jax_experiment_e2e(tmp_path):
     """Full path: driver -> subprocess worker -> pjit train loop -> ckpt."""
     from tf_yarn_tpu.client import run_on_tpu

@@ -1,0 +1,98 @@
+"""Throughput measurement harness (BASELINE.md's measurement surface).
+
+One timed jitted-train-step loop shared by bench.py (the driver's single
+headline metric) and benchmarks/run.py (the per-config BASELINE.json
+suite). Mirrors what the reference measures — steps/sec and wall time
+(reference: tensorflow/metrics.py:35-38, client.py:699-731) — expressed
+as samples/sec/chip.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional
+
+_logger = logging.getLogger(__name__)
+
+
+def measure_throughput(
+    model: Any,
+    loss_fn: Any,
+    optimizer: Any,
+    batch: Dict[str, Any],
+    mesh_spec=None,
+    steps: int = 20,
+    warmup: int = 3,
+    init_fn=None,
+    devices=None,
+) -> Dict[str, float]:
+    """Time `steps` jitted train steps; returns throughput stats.
+
+    batch: host numpy arrays (leading dim = global batch).
+    """
+    import jax
+    import numpy as np
+
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+    from tf_yarn_tpu.parallel.sharding import tree_shardings, unbox_params
+    from tf_yarn_tpu.training import TrainState, build_train_step
+
+    if devices is None:
+        devices = select_devices()
+    if mesh_spec is None:
+        mesh_spec = MeshSpec.auto(len(devices))
+    mesh = build_mesh(mesh_spec, devices)
+    rng = jax.random.PRNGKey(0)
+    sample = next(iter(batch.values()))
+    batch_size = int(np.asarray(sample).shape[0])
+
+    if init_fn is None:
+        def init_fn(rng, batch):
+            features = {k: v for k, v in batch.items() if k != "y"}
+            if len(features) == 1:
+                return model.init(rng, next(iter(features.values())))
+            return model.init(rng, **features)
+
+    with mesh:
+        def init_state(rng, batch):
+            variables = init_fn(rng, batch)
+            params = unbox_params(variables)
+            return TrainState(np.int32(0), params, optimizer.init(params))
+
+        def init_boxed(rng, batch):
+            variables = init_fn(rng, batch)
+            return TrainState(np.int32(0), variables, optimizer.init(variables))
+
+        placed = {k: jax.device_put(np.asarray(v)) for k, v in batch.items()}
+        abstract = jax.eval_shape(init_boxed, rng, placed)
+        shardings = tree_shardings(mesh, abstract)
+        state = jax.jit(init_state, out_shardings=shardings)(rng, placed)
+        step_fn = jax.jit(
+            build_train_step(model, loss_fn, optimizer),
+            donate_argnums=(0,),
+            out_shardings=(shardings, None),
+        )
+
+        t0 = time.time()
+        for _ in range(warmup):
+            state, metrics = step_fn(state, placed, rng)
+        jax.block_until_ready(state.params)
+        compile_time = time.time() - t0
+
+        t0 = time.time()
+        for _ in range(steps):
+            state, metrics = step_fn(state, placed, rng)
+        jax.block_until_ready(state.params)
+        elapsed = time.time() - t0
+
+    samples_per_sec = steps * batch_size / elapsed
+    return {
+        "samples_per_sec": samples_per_sec,
+        "samples_per_sec_per_chip": samples_per_sec / len(devices),
+        "steps_per_sec": steps / elapsed,
+        "step_time_ms": 1000 * elapsed / steps,
+        "compile_plus_warmup_s": compile_time,
+        "n_devices": float(len(devices)),
+        "final_loss": float(metrics["loss"]),
+    }

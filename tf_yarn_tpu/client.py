@@ -213,6 +213,7 @@ def _execute_and_await_termination(
     n_try: int,
     poll_every_secs: float,
     eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
+    timeout_secs: Optional[float] = None,
 ) -> Metrics:
     """Post the experiment, poll to completion, fold events into Metrics
     (reference: client.py:527-631)."""
@@ -237,11 +238,19 @@ def _execute_and_await_termination(
     )
 
     status = RUNNING
+    deadline = time.time() + timeout_secs if timeout_secs else None
     while status == RUNNING:
         time.sleep(poll_every_secs)
         status = cluster.handle.status()
         evaluator_logger.log()
         tb_url_logger.log()
+        if deadline and time.time() > deadline and status == RUNNING:
+            # Hung cluster (deadlocked collective, stuck host): kill it so
+            # the retry loop / caller gets control back.
+            _logger.error("run exceeded timeout_secs=%s; killing", timeout_secs)
+            cluster.handle.kill()
+            status = KILLED
+            break
 
     if hasattr(cluster.handle, "reap_sidecars"):
         cluster.handle.reap_sidecars()
@@ -311,6 +320,7 @@ def run_on_tpu(
     pre_script_hook: str = "",
     nb_retries: int = 0,
     poll_every_secs: float = 0.5,
+    timeout_secs: Optional[float] = None,
     coordinator_bind: str = "127.0.0.1",
     eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
 ) -> Optional[Metrics]:
@@ -350,6 +360,7 @@ def run_on_tpu(
                 n_try,
                 poll_every_secs,
                 eval_monitor_log_thresholds,
+                timeout_secs,
             )
         except KeyboardInterrupt:
             _shutdown_on_exception(cluster, KILLED)

@@ -59,6 +59,24 @@ def _mark_evaluated(model_dir: str, step: int, metrics: dict) -> None:
         json.dump(metrics, fh)
 
 
+def evaluate_checkpoint(
+    model, loss_fn, model_dir: str, step: int, eval_input_fn, eval_steps: int,
+    rng_seed: int = 0,
+) -> dict:
+    """Host-restore ckpt-<step> and evaluate it on `eval_input_fn` (shared
+    by the side-car loop and Estimator.evaluate)."""
+    from tf_yarn_tpu.training import TrainState, build_eval_step, evaluate
+
+    state = ckpt_lib.restore_checkpoint_host(model_dir, step)
+    params = state["params"] if isinstance(state, dict) else state.params
+    eval_state = TrainState(step=0, params=params, opt_state=())
+    eval_step = jax.jit(build_eval_step(model, loss_fn))
+    return evaluate(
+        eval_step, eval_state, eval_input_fn, lambda b: b, eval_steps,
+        jax.random.PRNGKey(rng_seed),
+    )
+
+
 def continuous_eval(
     runtime: Optional[_bootstrap.TaskRuntime],
     experiment,

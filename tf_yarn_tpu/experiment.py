@@ -89,6 +89,36 @@ class Estimator:
     def config(self) -> Dict[str, Any]:  # parity: Experiment.config property
         return {"model_dir": self.model_dir}
 
+    def train(self, input_fn: InputFn, max_steps: int, **train_params) -> Dict:
+        """In-process training (tf.estimator.Estimator.train familiarity;
+        distributed runs go through run_on_tpu with an ExperimentSpec)."""
+        import dataclasses as _dc
+
+        from tf_yarn_tpu import training
+
+        spec = ExperimentSpec(
+            estimator=self,
+            train_spec=TrainSpec(input_fn=input_fn, max_steps=max_steps),
+        )
+        core = as_core_experiment(spec)
+        if train_params:  # unknown keys raise TypeError, not silence
+            core.train_params = _dc.replace(core.train_params, **train_params)
+        return training.train_and_evaluate(core)
+
+    def evaluate(self, input_fn: InputFn, steps: int = 10) -> Dict:
+        """Evaluate the latest checkpoint in model_dir on `input_fn`."""
+        from tf_yarn_tpu import checkpoint as ckpt_lib
+        from tf_yarn_tpu.evaluation import evaluate_checkpoint
+
+        if not self.model_dir:
+            raise ValueError("evaluate() needs a model_dir with checkpoints")
+        step = ckpt_lib.latest_checkpoint_step(self.model_dir)
+        if step is None:
+            raise ValueError(f"no checkpoints in {self.model_dir}")
+        return evaluate_checkpoint(
+            self.model, self.loss_fn, self.model_dir, step, input_fn, steps
+        )
+
 
 class TrainSpec(NamedTuple):
     input_fn: InputFn

@@ -20,7 +20,30 @@ def _log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
+def _probe_backend_alive(timeout_secs: float = 180.0) -> bool:
+    """Check device init in a throwaway subprocess. A wedged TPU relay
+    hangs `jax.devices()` indefinitely; benching must degrade to the CPU
+    fallback line rather than hang the caller."""
+    import subprocess
+
+    if os.environ.get("TPU_YARN_PLATFORM"):
+        return True  # explicitly forced; nothing to probe
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_secs,
+            capture_output=True,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def bench_flagship_train():
+    if not _probe_backend_alive():
+        _log("default backend unreachable (hung device init); forcing CPU")
+        os.environ["TPU_YARN_PLATFORM"] = "cpu"
+
     import numpy as np
 
     from tf_yarn_tpu.benchmark import measure_throughput

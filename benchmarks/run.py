@@ -189,7 +189,7 @@ def main() -> None:
     parser.add_argument("--cpu", action="store_true", help="force tiny CPU shapes")
     args = parser.parse_args()
     if args.cpu:
-        os.environ.setdefault("TPU_YARN_PLATFORM", "cpu")
+        os.environ["TPU_YARN_PLATFORM"] = "cpu"  # explicit flag wins over env
     unknown = [name for name in args.configs if name not in CONFIGS]
     if unknown:
         parser.error(

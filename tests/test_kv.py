@@ -137,3 +137,22 @@ def test_large_value(kv):
     blob = b"q" * (2 * 1024 * 1024)
     kv.put("big", blob)
     assert kv.get("big") == blob
+
+
+def test_many_concurrent_waiters(kv):
+    # A barrier-like burst: 12 threads block on distinct keys, one thread
+    # publishes them all; every waiter must wake with its own value.
+    results = {}
+
+    def waiter(i):
+        results[i] = kv.wait(f"burst/{i}", timeout=15.0)
+
+    threads = [threading.Thread(target=waiter, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    for i in range(12):
+        kv.put(f"burst/{i}", f"v{i}".encode())
+    for t in threads:
+        t.join(timeout=10.0)
+    assert results == {i: f"v{i}".encode() for i in range(12)}

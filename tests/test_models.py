@@ -89,6 +89,39 @@ def test_lora_freezes_base_params():
     assert changed_lora > 0  # adapters moved
 
 
+def test_chunked_lm_loss_matches_full():
+    from tf_yarn_tpu.models.common import lm_loss, lm_loss_chunked
+
+    cfg = transformer.TransformerConfig.tiny(scan_layers=False, remat=False)
+    model = transformer.Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    rng = jax.random.PRNGKey(1)
+    full, _ = lm_loss(model, params, {"tokens": tokens}, rng)
+    # Chunk smaller than vocab (256) and non-dividing to hit the pad path.
+    chunked, _ = lm_loss_chunked(
+        model, params, {"tokens": tokens}, rng, chunk_size=100
+    )
+    np.testing.assert_allclose(float(chunked), float(full), rtol=2e-3)
+
+    # Gradients agree too (the path exists to be trained through).
+    g_full = jax.grad(lambda p: lm_loss(model, p, {"tokens": tokens}, rng)[0])(params)
+    g_chunk = jax.grad(
+        lambda p: lm_loss_chunked(model, p, {"tokens": tokens}, rng,
+                                  chunk_size=100)[0]
+    )(params)
+    leaf_f = jax.tree_util.tree_leaves(g_full)[0]
+    leaf_c = jax.tree_util.tree_leaves(g_chunk)[0]
+    # bf16 matmuls accumulate in different orders on the two paths; allow
+    # half-precision-scale noise.
+    np.testing.assert_allclose(np.asarray(leaf_c), np.asarray(leaf_f), atol=2e-2)
+
+
 def test_moe_transformer_trains_with_expert_parallelism():
     cfg = transformer.TransformerConfig.tiny(moe_experts=4)
     exp = transformer.make_experiment(

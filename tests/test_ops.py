@@ -32,6 +32,30 @@ def test_rmsnorm_grad_matches_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_quantize_int8_roundtrip():
+    from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 64).astype(np.float32) * 3.0)
+    values, scales = quantize_int8(x)
+    assert values.dtype == jnp.int8
+    assert scales.shape == (16, 1)
+    recovered = dequantize_int8(values, scales)
+    # Per-row scale keeps quantization error within half a step.
+    max_err = np.abs(np.asarray(recovered) - np.asarray(x)).max()
+    step = float(np.asarray(scales).max())
+    assert max_err <= step * 0.51 + 1e-6
+
+
+def test_quantize_int8_batched_shape():
+    from tf_yarn_tpu.ops.quantize import quantize_int8
+
+    x = jnp.ones((2, 8, 32))
+    values, scales = quantize_int8(x)
+    assert values.shape == (2, 8, 32)
+    assert scales.shape == (2, 8, 1)
+
+
 def test_transformer_with_fused_norms():
     from tf_yarn_tpu.models import transformer
 

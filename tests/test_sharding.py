@@ -31,6 +31,36 @@ def test_build_mesh_on_cpu_devices():
         build_mesh(MeshSpec(dp=3), devices)
 
 
+class _FakeSliceDevice:
+    """Stand-in for a multi-slice pod device (real CpuDevices carry no
+    slice_index, so DCN grouping is unit-tested with fakes)."""
+
+    def __init__(self, id_, slice_index):
+        self.id = id_
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"fake(id={self.id}, slice={self.slice_index})"
+
+
+def test_build_mesh_multislice_groups_outer_axes():
+    # 8 devices across 2 slices, interleaved on purpose; dp=2 must align
+    # with slice boundaries: slice 0 fills dp row 0, slice 1 row 2.
+    devices = [
+        _FakeSliceDevice(i, slice_index=i % 2) for i in range(8)
+    ]
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=4), devices)
+    dp_rows = mesh.devices.reshape(2, 4)
+    assert {d.slice_index for d in dp_rows[0]} == {0}
+    assert {d.slice_index for d in dp_rows[1]} == {1}
+
+
+def test_build_mesh_multislice_rejects_inner_axis_split():
+    devices = [_FakeSliceDevice(i, slice_index=i % 2) for i in range(8)]
+    with pytest.raises(ValueError, match="divisible by the slice count"):
+        build_mesh(MeshSpec(fsdp=8), devices)  # pp*dp == 1 < 2 slices
+
+
 def test_logical_to_spec():
     assert logical_to_spec(("batch", "embed")) == P(("dp", "fsdp"), "fsdp")
     assert logical_to_spec(("embed", "mlp")) == P("fsdp", "tp")

@@ -63,8 +63,10 @@ def evaluate_checkpoint(
     model, loss_fn, model_dir: str, step: int, eval_input_fn, eval_steps: int,
     rng_seed: int = 0,
 ) -> dict:
-    """Host-restore ckpt-<step> and evaluate it on `eval_input_fn` (shared
-    by the side-car loop and Estimator.evaluate)."""
+    """Host-restore ckpt-<step> and evaluate it on `eval_input_fn`
+    (Estimator.evaluate's one-shot path; the side-car loop keeps its own
+    copy with a pre-built jitted eval_step so repeated checkpoints reuse
+    one compilation)."""
     from tf_yarn_tpu.training import TrainState, build_eval_step, evaluate
 
     state = ckpt_lib.restore_checkpoint_host(model_dir, step)

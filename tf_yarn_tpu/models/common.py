@@ -70,6 +70,74 @@ def lm_loss(model, params, batch, rng, train=True):
     return loss, aux
 
 
+def lm_loss_chunked(model, params, batch, rng, train=True, chunk_size=8192):
+    """Next-token cross-entropy without materializing [B, S, vocab] logits.
+
+    The HBM saver for large-vocab decoders (llama-3's 128k vocab makes
+    full f32 logits the single biggest activation): hidden states come out
+    of the model once; the head matmul + logsumexp run per vocab chunk
+    inside a `lax.scan`, accumulating max/sum-exp online and gathering the
+    target logit — O(B*S*chunk) live memory instead of O(B*S*V).
+    Same semantics as `lm_loss` (no MoE-aux collection on this path yet).
+    """
+    tokens = batch["tokens"]
+    hidden = model.apply(
+        params, tokens, rngs={"dropout": rng}, deterministic=not train,
+        return_hidden=True,
+    )  # [B, S, D]
+    head = params["params"]["lm_head"]  # [D, V]
+    vocab = head.shape[-1]
+    n_chunks = -(-vocab // chunk_size)
+    pad = n_chunks * chunk_size - vocab
+    if pad:
+        head = jnp.pad(head, ((0, 0), (0, pad)))
+    head_chunks = head.reshape(head.shape[0], n_chunks, chunk_size)
+    head_chunks = jnp.moveaxis(head_chunks, 1, 0)  # [n_chunks, D, chunk]
+
+    h = hidden[:, :-1]  # predict positions 1..S-1
+    targets = tokens[:, 1:]
+    b, s, d = h.shape
+    h2 = h.reshape(b * s, d)
+    t2 = targets.reshape(b * s)
+
+    def body(carry, inp):
+        m, l, tgt_logit = carry
+        chunk_idx, w = inp
+        logits = (h2 @ w.astype(h2.dtype)).astype(jnp.float32)  # [BS, chunk]
+        base = chunk_idx * chunk_size
+        if pad:  # padded tail columns must not contribute
+            col = jnp.arange(chunk_size)[None, :] + base
+            logits = jnp.where(col < vocab, logits, -1e30)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # Gather this chunk's target logits where they fall in range.
+        local = t2 - base
+        in_range = (local >= 0) & (local < chunk_size)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk_size - 1)[:, None], axis=-1
+        )[:, 0]
+        tgt_logit = jnp.where(in_range, picked, tgt_logit)
+        return (m_new, l, tgt_logit), None
+
+    m0 = jnp.full((b * s,), -1e30, jnp.float32)
+    l0 = jnp.zeros((b * s,), jnp.float32)
+    t0 = jnp.zeros((b * s,), jnp.float32)
+    (m, l, tgt_logit), _ = jax.lax.scan(
+        body, (m0, l0, t0), (jnp.arange(n_chunks), head_chunks)
+    )
+    logsumexp = m + jnp.log(jnp.maximum(l, 1e-30))
+    loss_per_tok = (logsumexp - tgt_logit).reshape(b, s)
+    if "mask" in batch:
+        mask = batch["mask"][:, 1:].astype(jnp.float32)
+        loss = (loss_per_tok * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    else:
+        loss = loss_per_tok.mean()
+    return loss, {"perplexity": jnp.exp(loss)}
+
+
 def synthetic_classification_iter(
     batch_size: int, feature_dim: int, num_classes: int, seed: int = 0
 ):

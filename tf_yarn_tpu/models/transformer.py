@@ -247,12 +247,18 @@ class _ScanBody(nn.Module):
 
 
 class Transformer(nn.Module):
-    """tokens [B, S] int32 -> logits [B, S, vocab]."""
+    """tokens [B, S] int32 -> logits [B, S, vocab].
+
+    `return_hidden=True` yields the pre-head hidden states [B, S, d]
+    instead — the seam the chunked-vocab loss uses to avoid materializing
+    the full [B, S, vocab] logits (models/common.lm_loss_chunked).
+    """
 
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         # deterministic accepted for loss-contract uniformity (this
         # decoder family carries no dropout).
         cfg = self.config
@@ -293,6 +299,8 @@ class Transformer(nn.Module):
             (cfg.d_model, cfg.vocab_size),
             cfg.param_dtype,
         )
+        if return_hidden:
+            return x
         return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
 
 
@@ -330,10 +338,19 @@ def make_experiment(
     learning_rate: float = 3e-4,
     mesh_spec=None,
     input_fn=None,
+    loss_chunk_size: Optional[int] = None,
     **train_param_overrides,
 ):
     """Causal-LM experiment (synthetic tokens unless input_fn given); LoRA
-    configs (config.lora_rank > 0) get the frozen-base optimizer."""
+    configs (config.lora_rank > 0) get the frozen-base optimizer.
+
+    `loss_chunk_size` switches to the chunked-vocab cross-entropy
+    (common.lm_loss_chunked) — set for large-vocab configs (>= ~64k) where
+    full [B, S, vocab] f32 logits dominate HBM. Defaults on automatically
+    for vocab >= 65536 unless MoE is active (the chunked path doesn't
+    collect the MoE aux loss yet)."""
+    import functools
+
     import optax
 
     from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
@@ -341,6 +358,18 @@ def make_experiment(
 
     config = config or TransformerConfig.tiny()
     seq_len = seq_len or config.max_seq_len
+    if loss_chunk_size and config.moe_experts:
+        raise ValueError(
+            "loss_chunk_size is incompatible with MoE configs: the chunked "
+            "loss does not collect the MoE aux loss yet"
+        )
+    if loss_chunk_size is None and config.vocab_size >= 65536 and not config.moe_experts:
+        loss_chunk_size = 16384
+    loss_fn = (
+        functools.partial(common.lm_loss_chunked, chunk_size=loss_chunk_size)
+        if loss_chunk_size
+        else common.lm_loss
+    )
     optimizer = (
         make_lora_optimizer(learning_rate)
         if config.lora_rank > 0
@@ -351,7 +380,7 @@ def make_experiment(
     return JaxExperiment(
         model=Transformer(config),
         optimizer=optimizer,
-        loss_fn=common.lm_loss,
+        loss_fn=loss_fn,
         train_input_fn=input_fn
         or (lambda: common.synthetic_token_iter(batch_size, seq_len, config.vocab_size)),
         train_params=TrainParams(**defaults),

@@ -1,0 +1,85 @@
+"""Int8 quantization kernels (pallas): per-row symmetric scale.
+
+The quantization pattern from the TPU kernel playbook (/opt/skills/guides/
+pallas_guide.md §Patterns: Quantization Kernels): per-row abs-max scales,
+int8 values, optional stochastic rounding via the on-chip PRNG (TPU only —
+interpret mode rounds to nearest). Useful for int8 activation/weight
+compression of checkpoints and comms.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _quantize_kernel(x_ref, values_ref, scales_ref, *, stochastic: bool,
+                     seed: int):
+    x = x_ref[...].astype(jnp.float32)
+    abs_max = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(abs_max, 1e-8) / 127.0
+    scaled = x / scale
+    if stochastic:
+        from jax.experimental.pallas import tpu as pltpu
+
+        pltpu.prng_seed(seed + pl.program_id(0))
+        bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+        values = pltpu.stochastic_round(scaled, bits, target_dtype=jnp.int8)
+    else:
+        values = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+    values_ref[...] = values
+    scales_ref[...] = scale.astype(jnp.float32)
+
+
+def quantize_int8(
+    x: jax.Array,
+    *,
+    stochastic: Optional[bool] = None,
+    seed: int = 0,
+    block_rows: int = 256,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """x [..., d] -> (int8 values [..., d], f32 scales [..., 1])."""
+    import math
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if stochastic is None:
+        stochastic = False  # deterministic by default; opt in on TPU
+    if stochastic and interpret:
+        raise ValueError("stochastic rounding needs the TPU PRNG (interpret=False)")
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for dim in orig_shape[:-1]:
+        rows *= dim
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        block_rows = math.gcd(rows, block_rows)
+    values, scales = pl.pallas_call(
+        functools.partial(_quantize_kernel, stochastic=stochastic, seed=seed),
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, 1), lambda i: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, d), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )(x2)
+    return (
+        values.reshape(orig_shape),
+        scales.reshape(*orig_shape[:-1], 1),
+    )
+
+
+def dequantize_int8(values: jax.Array, scales: jax.Array, dtype=jnp.float32):
+    return (values.astype(jnp.float32) * scales).astype(dtype)

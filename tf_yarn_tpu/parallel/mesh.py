@@ -29,7 +29,7 @@ import json
 import logging
 import math
 import os
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -147,8 +147,25 @@ def select_devices(n: Optional[int] = None, platform: Optional[str] = None):
     return devices
 
 
+def _slice_ids(devices) -> List[int]:
+    """slice_index per device (multi-slice TPU pods expose it; everything
+    else counts as one slice)."""
+    return [getattr(d, "slice_index", 0) for d in devices]
+
+
 def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
-    """Build the named Mesh for `spec` (row-major device assignment)."""
+    """Build the named Mesh for `spec`.
+
+    Single-slice (the common case): row-major assignment — the fastest-
+    varying axes (tp/ep) land on directly-wired ICI neighbors.
+
+    Multi-slice pods (devices carrying distinct `slice_index`): the outer
+    axes (pp, then dp) must align with slice boundaries so only their
+    infrequent collectives cross DCN, while fsdp/sp/tp/ep stay inside a
+    slice on ICI (the scaling-book recipe; SURVEY.md §5 "data plane ...
+    DCN collectives across slices"). Requires the leading pp*dp product to
+    be divisible by the slice count.
+    """
     from jax.sharding import Mesh
 
     if devices is None:
@@ -158,6 +175,23 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
             f"MeshSpec wants {spec.total_devices} devices "
             f"({dict(zip(spec.axis_names, spec.axis_sizes))}), got {len(devices)}"
         )
+    slice_ids = _slice_ids(devices)
+    n_slices = len(set(slice_ids))
+    if n_slices > 1:
+        outer = spec.pp * spec.dp
+        if outer % n_slices:
+            raise ValueError(
+                f"multi-slice mesh needs pp*dp ({spec.pp}*{spec.dp}) "
+                f"divisible by the slice count {n_slices} so cross-DCN "
+                "traffic stays on the outer axes"
+            )
+        per_slice = len(devices) // n_slices
+        grouped: Dict[int, list] = {}
+        for device, sid in zip(devices, slice_ids):
+            grouped.setdefault(sid, []).append(device)
+        if any(len(group) != per_slice for group in grouped.values()):
+            raise ValueError("slices contribute unequal device counts")
+        devices = [d for sid in sorted(grouped) for d in grouped[sid]]
     mesh_devices = np.asarray(devices).reshape(spec.axis_sizes)
     return Mesh(mesh_devices, spec.axis_names)
 

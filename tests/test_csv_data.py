@@ -33,6 +33,35 @@ def test_train_test_split_deterministic(csv_file):
     assert 5 <= len(test1["y"]) <= 40  # roughly the requested fraction
 
 
+def test_text_dataset_packing(tmp_path):
+    from tf_yarn_tpu.data.text import TextDataset, pack_tokens
+
+    path = tmp_path / "corpus.txt"
+    path.write_text("\n".join(f"doc {i} " + "w " * 10 for i in range(40)))
+
+    # Toy tokenizer: one int per whitespace token.
+    def tokenize(line):
+        return [hash(w) % 100 for w in line.split()]
+
+    ds = TextDataset(str(path), tokenize, batch_size=4, seq_len=16)
+    batches = list(ds)
+    assert batches, "expected at least one packed batch"
+    for batch in batches:
+        assert batch["tokens"].shape == (4, 16)
+        assert batch["tokens"].dtype == np.int32
+
+    # Sharded ranks see disjoint lines; both still produce full windows.
+    ds0 = TextDataset(str(path), tokenize, 2, 16, rank=0, world_size=2)
+    ds1 = TextDataset(str(path), tokenize, 2, 16, rank=1, world_size=2)
+    assert list(ds0) and list(ds1)
+
+    # pack_tokens emits exact windows with no padding.
+    windows = list(pack_tokens(iter([[1] * 10, [2] * 10]), 8))
+    assert [w.shape for w in windows] == [(8,), (8,)]
+    assert windows[0].tolist() == [1] * 8
+    assert windows[1].tolist() == [1, 1, 2, 2, 2, 2, 2, 2]
+
+
 def test_batch_iterator_sharded(csv_file):
     data = load_csv(csv_file, label_column="quality")
     it0 = batch_iterator(data, 10, shuffle=False, repeat=False, world_size=2, rank=0)

@@ -72,6 +72,39 @@ def test_eval_loop(tmp_path):
     assert np.isfinite(metrics["eval_loss"])
 
 
+def test_grad_accumulation_matches_full_batch():
+    """accum=4 over one global batch must produce the same update as a
+    single full-batch step (mean-loss gradients are linear)."""
+    import jax
+    import optax
+
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.mnist import DenseClassifier
+    from tf_yarn_tpu.training import TrainState, build_train_step
+
+    model = DenseClassifier(hidden_sizes=(16,), num_classes=4)
+    batch = next(common.synthetic_classification_iter(32, 16, 4))
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, batch["x"])
+    optimizer = optax.sgd(0.1)
+
+    def run(accum):
+        state = TrainState(np.int32(0), variables, optimizer.init(variables))
+        step = build_train_step(
+            model, common.classification_loss, optimizer, grad_accum_steps=accum
+        )
+        new_state, metrics = jax.jit(step)(state, batch, rng)
+        return new_state, metrics
+
+    s1, m1 = run(1)
+    s4, m4 = run(4)
+    assert abs(float(m1["loss"]) - float(m4["loss"])) < 1e-5
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(s4.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_estimator_train_and_evaluate_methods(tmp_path):
     import optax
 

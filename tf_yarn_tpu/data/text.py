@@ -1,0 +1,95 @@
+"""Text → token-batch pipeline for causal-LM training.
+
+Tokenizes raw text files with a HuggingFace tokenizer (the `transformers`
+library ships in TPU VM images), packs tokens into fixed-length sequences
+(static shapes for XLA), and shards sample-level across ranks like every
+other pipeline in tf_yarn_tpu.data.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+
+def load_tokenizer(name_or_path: str):
+    """A HF tokenizer (local path or hub name; hub needs network)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(name_or_path)
+
+
+def pack_tokens(
+    token_stream: Iterator[List[int]], seq_len: int
+) -> Iterator[np.ndarray]:
+    """Concatenate documents and emit fixed [seq_len] windows (GPT-style
+    packing — no padding waste, static shapes)."""
+    buffer: List[int] = []
+    for tokens in token_stream:
+        buffer.extend(tokens)
+        while len(buffer) >= seq_len:
+            yield np.asarray(buffer[:seq_len], np.int32)
+            buffer = buffer[seq_len:]
+
+
+class TextDataset:
+    """{.txt files} -> {"tokens": [batch, seq_len] int32} batches.
+
+    `tokenize_fn` maps a text line to token ids — pass
+    `load_tokenizer(...).encode` or any callable (tests use a toy fn), so
+    the pipeline itself never requires network access.
+    """
+
+    def __init__(
+        self,
+        paths: "str | Sequence[str]",
+        tokenize_fn,
+        batch_size: int,
+        seq_len: int,
+        rank: int = 0,
+        world_size: int = 1,
+        repeat: bool = False,
+    ) -> None:
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+        self.tokenize_fn = tokenize_fn
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.rank = rank
+        self.world_size = world_size
+        self.repeat = repeat
+
+    def _token_stream(self) -> Iterator[List[int]]:
+        line_idx = 0
+        for path in self.paths:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    # Sample-level sharding at line granularity.
+                    if line_idx % self.world_size == self.rank:
+                        yield list(self.tokenize_fn(line))
+                    line_idx += 1
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            sequences: List[np.ndarray] = []
+            windows = 0
+            for window in pack_tokens(self._token_stream(), self.seq_len):
+                windows += 1
+                sequences.append(window)
+                if len(sequences) == self.batch_size:
+                    yield {"tokens": np.stack(sequences)}
+                    sequences = []
+            if not self.repeat:
+                return
+            if windows == 0:
+                raise ValueError(
+                    f"rank {self.rank}/{self.world_size} produced no full "
+                    f"{self.seq_len}-token window from {self.paths}; cannot "
+                    "repeat forever without data"
+                )

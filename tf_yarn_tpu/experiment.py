@@ -43,6 +43,10 @@ class TrainParams:
     checkpoint_every_steps: Optional[int] = None
     log_every_steps: int = 10
     seed: int = 0
+    # Split each global batch into N sequential microbatches, averaging
+    # gradients before the single optimizer update (HBM for batch size).
+    # Global batch must divide by N x the data-axis sharding.
+    grad_accum_steps: int = 1
 
 
 @dataclasses.dataclass

@@ -125,8 +125,12 @@ def lm_loss_chunked(model, params, batch, rng, train=True, chunk_size=8192):
     m0 = jnp.full((b * s,), -1e30, jnp.float32)
     l0 = jnp.zeros((b * s,), jnp.float32)
     t0 = jnp.zeros((b * s,), jnp.float32)
+    # Remat the chunk body: without it autodiff stacks each chunk's
+    # logits-sized residuals across the scan — O(B*S*V) again, exactly
+    # what this loss exists to avoid. Recomputing the chunk matmul in the
+    # backward keeps the O(B*S*chunk) footprint.
     (m, l, tgt_logit), _ = jax.lax.scan(
-        body, (m0, l0, t0), (jnp.arange(n_chunks), head_chunks)
+        jax.checkpoint(body), (m0, l0, t0), (jnp.arange(n_chunks), head_chunks)
     )
     logsumexp = m + jnp.log(jnp.maximum(l, 1e-30))
     loss_per_tok = (logsumexp - tgt_logit).reshape(b, s)

@@ -105,16 +105,62 @@ def _loss_caller(loss_fn):
     )
 
 
-def build_train_step(model, loss_fn, optimizer):
+def build_train_step(model, loss_fn, optimizer, grad_accum_steps: int = 1):
     loss_fn = _loss_caller(loss_fn)
+
+    def _grads(params, batch, rng):
+        return jax.value_and_grad(
+            lambda p: loss_fn(model, p, batch, rng, train=True), has_aux=True
+        )(params)
 
     def train_step(state: TrainState, batch, base_rng):
         rng = jax.random.fold_in(base_rng, state.step)
-        grad_fn = jax.value_and_grad(
-            lambda params: loss_fn(model, params, batch, rng, train=True),
-            has_aux=True,
-        )
-        (loss, aux), grads = grad_fn(state.params)
+        if grad_accum_steps == 1:
+            (loss, aux), grads = _grads(state.params, batch, rng)
+        else:
+            # Sequential microbatches inside the jitted step: scan keeps
+            # one microbatch of activations live at a time; the averaged
+            # gradient is mathematically the full-batch gradient.
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape(
+                    grad_accum_steps, x.shape[0] // grad_accum_steps, *x.shape[1:]
+                ),
+                batch,
+            )
+
+            import operator
+
+            import jax.numpy as jnp
+
+            def body(carry, inp):
+                micro_idx, micro_batch = inp
+                grads_acc, loss_acc, aux_acc = carry
+                # Independent dropout per microbatch (same rng would
+                # correlate masks across the accumulation).
+                (loss, aux), grads = _grads(
+                    state.params, micro_batch, jax.random.fold_in(rng, micro_idx)
+                )
+                grads_acc = jax.tree_util.tree_map(operator.add, grads_acc, grads)
+                aux_acc = jax.tree_util.tree_map(operator.add, aux_acc, aux)
+                return (grads_acc, loss_acc + loss, aux_acc), None
+
+            first = jax.tree_util.tree_map(lambda leaf: leaf[0], micro)
+            (loss0, aux0), grads0 = _grads(
+                state.params, first, jax.random.fold_in(rng, 0)
+            )
+            rest = jax.tree_util.tree_map(lambda leaf: leaf[1:], micro)
+            (grads_sum, loss_sum, aux_sum), _ = jax.lax.scan(
+                body, (grads0, loss0, aux0),
+                (jnp.arange(1, grad_accum_steps), rest),
+            )
+            scale = 1.0 / grad_accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads_sum)
+            loss = loss_sum * scale
+            aux = jax.tree_util.tree_map(lambda a: a * scale, aux_sum)
+            if "perplexity" in aux:
+                # exp(mean) not mean(exp): keep perplexity consistent with
+                # the accum=1 path (Jensen gap otherwise).
+                aux["perplexity"] = jnp.exp(loss)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **aux}
@@ -241,7 +287,10 @@ def train_and_evaluate(
                 _logger.info("resumed from checkpoint step %d", resume_step)
 
         train_step = jax.jit(
-            build_train_step(core.model, core.loss_fn, core.optimizer),
+            build_train_step(
+                core.model, core.loss_fn, core.optimizer,
+                grad_accum_steps=params_cfg.grad_accum_steps,
+            ),
             donate_argnums=(0,),
             out_shardings=(state_shardings, None),
         )

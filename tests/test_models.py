@@ -89,6 +89,18 @@ def test_lora_freezes_base_params():
     assert changed_lora > 0  # adapters moved
 
 
+def test_adafactor_optimizer_option():
+    exp = transformer.make_experiment(
+        transformer.TransformerConfig.tiny(),
+        train_steps=4, batch_size=8, seq_len=16,
+        mesh_spec=MeshSpec(fsdp=8), optimizer="adafactor",
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
+    assert np.isfinite(metrics["loss"])
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        transformer.make_experiment(optimizer="sgdmax")
+
+
 def test_chunked_lm_loss_matches_full():
     from tf_yarn_tpu.models.common import lm_loss, lm_loss_chunked
 

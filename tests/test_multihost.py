@@ -11,6 +11,48 @@ from tf_yarn_tpu.client import run_on_tpu
 from tf_yarn_tpu.topologies import TaskSpec
 
 
+def test_chief_plus_worker_multihost(tmp_path):
+    """Mixed task types: chief:0 must become jax process 0 and worker:0
+    process 1 (the deterministic ordering _maybe_init_jax_distributed
+    derives), with one shared world."""
+    out = str(tmp_path / "world")
+
+    def experiment_fn():
+        import optax
+
+        from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+        from tf_yarn_tpu.models import common, mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        def input_fn():
+            import os
+
+            import jax
+
+            with open(f"{out}-{jax.process_index()}", "w") as fh:
+                fh.write(os.environ["TPU_YARN_TASK"])
+            return common.synthetic_classification_iter(4, 16, 4)
+
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=input_fn,
+            train_params=TrainParams(train_steps=4, log_every_steps=2),
+            mesh_spec=MeshSpec(dp=2),
+        )
+
+    run_on_tpu(
+        experiment_fn,
+        {"chief": TaskSpec(instances=1), "worker": TaskSpec(instances=1)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        poll_every_secs=0.3,
+    )
+    # The deterministic ordering: chief owns jax process 0.
+    assert open(f"{out}-0").read() == "chief:0"
+    assert open(f"{out}-1").read() == "worker:0"
+
+
 def test_two_process_data_parallel_training(tmp_path):
     out = str(tmp_path / "world")
 

@@ -339,6 +339,7 @@ def make_experiment(
     mesh_spec=None,
     input_fn=None,
     loss_chunk_size: Optional[int] = None,
+    optimizer: "Optional[str | object]" = None,
     **train_param_overrides,
 ):
     """Causal-LM experiment (synthetic tokens unless input_fn given); LoRA
@@ -370,11 +371,27 @@ def make_experiment(
         if loss_chunk_size
         else common.lm_loss
     )
-    optimizer = (
-        make_lora_optimizer(learning_rate)
-        if config.lora_rank > 0
-        else optax.adamw(learning_rate)
-    )
+    if optimizer == "adafactor":
+        # Factored second moments: optimizer state shrinks from 2x params
+        # to ~params + O(rows+cols) — the HBM saver for full fine-tunes of
+        # multi-B-param models on small slices.
+        optimizer = optax.adafactor(learning_rate)
+    elif optimizer == "adamw":
+        optimizer = optax.adamw(learning_rate)
+    elif isinstance(optimizer, str):
+        raise ValueError(
+            f"unknown optimizer {optimizer!r}; use 'adamw', 'adafactor', or "
+            "pass an optax GradientTransformation"
+        )
+    if config.lora_rank > 0:
+        # LoRA always keeps the base frozen, whatever inner optimizer was
+        # chosen: adapters get it, everything else is zeroed.
+        inner = optimizer if optimizer is not None else optax.adamw(learning_rate)
+        optimizer = optax.multi_transform(
+            {"lora": inner, "frozen": optax.set_to_zero()}, lora_label_tree
+        )
+    elif optimizer is None:
+        optimizer = optax.adamw(learning_rate)
     defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
     defaults.update(train_param_overrides)
     return JaxExperiment(

@@ -94,10 +94,14 @@ def infer_fsdp_partition(shape: Tuple[int, ...], fsdp_size: int) -> PartitionSpe
 def _leaf_spec(leaf, fsdp_size: int) -> PartitionSpec:
     # flax `nn.with_partitioning` wraps leaves in nn.Partitioned with .names.
     names = getattr(leaf, "names", None)
-    if names is not None:
+    value = getattr(leaf, "value", leaf)
+    shape = tuple(getattr(value, "shape", ()))
+    if names is not None and len(names) == len(shape):
         return logical_to_spec(names)
-    shape = getattr(leaf, "shape", ())
-    return infer_fsdp_partition(tuple(shape), fsdp_size)
+    # Rank mismatch happens when an optimizer builds reduced-rank state
+    # from boxed params (adafactor's row/col factors keep the box but drop
+    # an axis) — the annotation no longer applies; infer instead.
+    return infer_fsdp_partition(shape, fsdp_size)
 
 
 def _is_leaf(node) -> bool:

@@ -21,7 +21,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from tf_yarn_tpu.ops.attention import attention
+from tf_yarn_tpu.ops.attention import attention, xla_attention
 
 # Logical axis names (mapped to mesh axes by parallel.sharding.LOGICAL_RULES).
 EMBED = "embed"
@@ -179,10 +179,12 @@ class LoraDense(nn.Module):
 
 class Attention(nn.Module):
     config: TransformerConfig
+    decode: bool = False  # static: KV-cache path (see _ScanBody note)
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
+        decode = self.decode
         b, s, _ = x.shape
         q = LoraDense(cfg.n_heads * cfg.head_dim, (EMBED, HEADS), cfg, name="wq")(x)
         k = LoraDense(cfg.n_kv_heads * cfg.head_dim, (EMBED, KV), cfg, name="wk")(x)
@@ -190,9 +192,44 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        out = attention(q, k, v, impl=cfg.attention_impl, causal=True)
+        if decode:
+            # KV cache for autoregressive decoding: append this call's
+            # keys/values at cache_index, attend against the whole cache
+            # (future slots masked by the offset causal mask).
+            cached_k = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros(
+                    (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype
+                ),
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros(
+                    (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype
+                ),
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = cache_index.value
+            positions = idx + jnp.arange(s, dtype=jnp.int32)[None, :]
+            positions = jnp.broadcast_to(positions, (b, s))
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+            )
+            cache_index.value = idx + s
+            out = xla_attention(
+                q, cached_k.value, cached_v.value, causal=True, segment_offset=idx
+            )
+        else:
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            out = attention(q, k, v, impl=cfg.attention_impl, causal=True)
         out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
         return LoraDense(cfg.d_model, (HEADS, EMBED), cfg, name="wo")(out)
 
@@ -212,11 +249,14 @@ class SwiGLU(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    decode: bool = False  # static: KV-cache path (see _ScanBody note)
 
     @nn.compact
     def __call__(self, x, positions):
         cfg = self.config
-        x = x + Attention(cfg, name="attn")(RMSNorm(cfg, name="attn_norm")(x), positions)
+        x = x + Attention(cfg, self.decode, name="attn")(
+            RMSNorm(cfg, name="attn_norm")(x), positions
+        )
         if cfg.moe_experts > 0:
             from tf_yarn_tpu.models.moe import MoEMlp
 
@@ -232,6 +272,9 @@ class _ScanBody(nn.Module):
     O(n_layers) — the HBM/FLOPs trade SURVEY's TPU notes call for)."""
 
     config: TransformerConfig
+    # Static module field, not a call arg: scan lifting would trace (or
+    # drop) an argument, and `decode` must stay a python bool.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -240,10 +283,13 @@ class _ScanBody(nn.Module):
                 Block,
                 policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
             )
-            if self.config.remat
+            if self.config.remat and not self.decode
             else Block
         )
-        return block_cls(self.config, name="block")(x, positions), None
+        return (
+            block_cls(self.config, self.decode, name="block")(x, positions),
+            None,
+        )
 
 
 class Transformer(nn.Module):
@@ -258,7 +304,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, decode: bool = False):
         # deterministic accepted for loss-contract uniformity (this
         # decoder family carries no dropout).
         cfg = self.config
@@ -277,8 +323,9 @@ class Transformer(nn.Module):
             scanned = nn.scan(
                 _ScanBody,
                 # intermediates rides along stacked so sown values (MoE aux
-                # loss) survive the scan lift.
-                variable_axes={"params": 0, "intermediates": 0},
+                # loss) survive the scan lift; cache likewise stacks each
+                # layer's KV cache for decoding.
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=nn.broadcast,
                 length=cfg.n_layers,
@@ -287,10 +334,10 @@ class Transformer(nn.Module):
                 # mesh shards whole layers across pipeline stages.
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = scanned(cfg, name="layers")(x, positions)
+            x, _ = scanned(cfg, decode, name="layers")(x, positions)
         else:
             for i in range(cfg.n_layers):
-                x = _ScanBody(cfg, name=f"layer_{i}")(x, positions)[0]
+                x = _ScanBody(cfg, decode, name=f"layer_{i}")(x, positions)[0]
 
         x = RMSNorm(cfg, name="final_norm")(x)
         head = self.param(
@@ -318,13 +365,16 @@ def lora_label_tree(params) -> Any:
     return jtu.tree_unflatten(treedef, [label(path) for path, _ in flat])
 
 
-def make_lora_optimizer(learning_rate: float = 1e-4):
-    """adamw on LoRA params, frozen base (reference has no analog — LoRA is
-    a BASELINE.json config 5 requirement)."""
+def make_lora_optimizer(learning_rate: float = 1e-4, inner=None):
+    """`inner` (default adamw) on LoRA params, frozen base (reference has
+    no analog — LoRA is a BASELINE.json config 5 requirement)."""
     import optax
 
     return optax.multi_transform(
-        {"lora": optax.adamw(learning_rate), "frozen": optax.set_to_zero()},
+        {
+            "lora": inner if inner is not None else optax.adamw(learning_rate),
+            "frozen": optax.set_to_zero(),
+        },
         lora_label_tree,
     )
 
@@ -386,10 +436,7 @@ def make_experiment(
     if config.lora_rank > 0:
         # LoRA always keeps the base frozen, whatever inner optimizer was
         # chosen: adapters get it, everything else is zeroed.
-        inner = optimizer if optimizer is not None else optax.adamw(learning_rate)
-        optimizer = optax.multi_transform(
-            {"lora": inner, "frozen": optax.set_to_zero()}, lora_label_tree
-        )
+        optimizer = make_lora_optimizer(learning_rate, inner=optimizer)
     elif optimizer is None:
         optimizer = optax.adamw(learning_rate)
     defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))

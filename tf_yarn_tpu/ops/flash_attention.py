@@ -96,12 +96,11 @@ def _flash_forward(
     block_k: int,
     interpret: bool,
 ) -> jax.Array:
+    from tf_yarn_tpu.ops.attention import _repeat_kv
+
     b, s_q, n_heads, head_dim = query.shape
     _, s_kv, n_kv, _ = key.shape
-    if n_heads != n_kv:  # GQA: expand kv heads (optimizable later)
-        rep = n_heads // n_kv
-        key = jnp.repeat(key, rep, axis=2)
-        value = jnp.repeat(value, rep, axis=2)
+    key, value = _repeat_kv(key, value, n_heads // n_kv)
 
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)

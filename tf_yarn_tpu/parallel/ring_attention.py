@@ -38,10 +38,15 @@ NEG_INF = -1e30
 def _block_attend(q, k, v, q_offset, k_offset, causal, scale):
     """Unnormalized blockwise attention: returns (m, l, acc) for merging.
 
-    q [B,Sq,H,D]; k/v [B,Sk,H,D] (kv heads already expanded). Positions are
-    global: q_offset/k_offset locate the shards in the full sequence so the
-    causal mask stays exact across the ring.
+    q [B,Sq,H,D]; k/v [B,Sk,Hkv,D] — GQA heads are expanded here, per
+    block, AFTER the ring hop, so the ppermute only moves Hkv heads
+    (H/Hkv x less ICI traffic than rotating expanded KV). Positions are
+    global: q_offset/k_offset locate the shards in the full sequence so
+    the causal mask stays exact across the ring.
     """
+    from tf_yarn_tpu.ops.attention import _repeat_kv
+
+    k, v = _repeat_kv(k, v, q.shape[2] // k.shape[2])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
@@ -72,11 +77,6 @@ def ring_attention(
     Shapes per shard: q [B, S_local, H, D], k/v [B, S_local, Hkv, D].
     """
     b, s_local, n_heads, head_dim = query.shape
-    n_kv = key.shape[2]
-    if n_heads != n_kv:
-        rep = n_heads // n_kv
-        key = jnp.repeat(key, rep, axis=2)
-        value = jnp.repeat(value, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
 
     sp = jax.lax.psum(1, axis_name)
@@ -96,9 +96,25 @@ def ring_attention(
         # kv currently held came from shard (my_idx - step) mod sp.
         src = (my_idx - step) % sp
         k_offset = src * s_local
-        m_blk, l_blk, acc_blk = _block_attend(
-            query, k_cur, v_cur, q_offset, k_offset, causal, scale
-        )
+
+        def compute(operands):
+            q, k, v, k_off = operands
+            return _block_attend(q, k, v, q_offset, k_off, causal, scale)
+
+        def skip(operands):
+            # Fully-masked block: identity under the online-softmax merge.
+            return m0, l0, acc0
+
+        if causal:
+            # Shards strictly after mine are entirely in the future: skip
+            # the whole block matmul (halves causal FLOPs on average; the
+            # per-device branch is data-dependent on axis_index, which
+            # lax.cond handles under shard_map).
+            m_blk, l_blk, acc_blk = jax.lax.cond(
+                src <= my_idx, compute, skip, (query, k_cur, v_cur, k_offset)
+            )
+        else:
+            m_blk, l_blk, acc_blk = compute((query, k_cur, v_cur, k_offset))
         m_new = jnp.maximum(m, m_blk)
         c_old = jnp.exp(m - m_new)
         c_blk = jnp.exp(m_blk - m_new)

@@ -13,7 +13,8 @@ for example in \
     collective_allreduce_example \
     llama_lora_example \
     pytorch_example \
-    evaluator_sidecar_example
+    evaluator_sidecar_example \
+    generate_example
 do
     echo "=== $example ==="
     python "examples/$example.py"

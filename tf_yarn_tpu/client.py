@@ -269,6 +269,8 @@ def _execute_and_await_termination(
         for t, o in outcomes.items()
         if o.status == "FAILED" and t.split(":", 1)[0] in ("chief", "worker")
     }
+    if failures:
+        _print_failed_task_logs(cluster, failures)
     sidecar_failures = {
         t: o
         for t, o in outcomes.items()
@@ -289,6 +291,30 @@ def _execute_and_await_termination(
             f"{sorted(failures) or 'none reported'}\n{details}"
         )
     return metrics
+
+
+def _print_failed_task_logs(
+    cluster: SliceCluster, failures: Dict[str, TaskOutcome], tail_lines: int = 25
+) -> None:
+    """Surface the tail of each failed task's log in the driver output —
+    the role of the reference's end-of-run log collection
+    (`_get_app_logs`, client.py:748-763)."""
+    logs = cluster.handle.logs()
+    for task in sorted(failures):
+        path = logs.get(task)
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            from collections import deque
+
+            with open(path, "r", errors="replace") as fh:
+                tail = list(deque(fh, maxlen=tail_lines))  # O(tail) memory
+        except OSError:
+            continue
+        _logger.error(
+            "---- last %d log lines of failed %s (%s) ----\n%s",
+            len(tail), task, path, "".join(tail).rstrip(),
+        )
 
 
 def _log_run_outcome(

@@ -11,6 +11,19 @@ import jax.numpy as jnp
 import optax
 
 
+def adamw_with_decay_mask(
+    learning_rate: float, weight_decay: float = 1e-4
+):
+    """AdamW that skips weight decay on 1D params (norm scales, biases) —
+    the standard transformer recipe. Identical to optax.adamw (same
+    default weight_decay) except for the mask."""
+
+    def mask(params):
+        return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+
+    return optax.adamw(learning_rate, weight_decay=weight_decay, mask=mask)
+
+
 def classification_loss(model, params, batch, rng, train=True):
     """Softmax cross-entropy + accuracy for models mapping x -> logits.
     `train=False` disables dropout (zoo models take `deterministic`)."""

@@ -427,7 +427,7 @@ def make_experiment(
         # multi-B-param models on small slices.
         optimizer = optax.adafactor(learning_rate)
     elif optimizer == "adamw":
-        optimizer = optax.adamw(learning_rate)
+        optimizer = common.adamw_with_decay_mask(learning_rate)
     elif isinstance(optimizer, str):
         raise ValueError(
             f"unknown optimizer {optimizer!r}; use 'adamw', 'adafactor', or "
@@ -436,9 +436,14 @@ def make_experiment(
     if config.lora_rank > 0:
         # LoRA always keeps the base frozen, whatever inner optimizer was
         # chosen: adapters get it, everything else is zeroed.
-        optimizer = make_lora_optimizer(learning_rate, inner=optimizer)
+        optimizer = make_lora_optimizer(
+            learning_rate,
+            inner=optimizer
+            if optimizer is not None
+            else common.adamw_with_decay_mask(learning_rate),
+        )
     elif optimizer is None:
-        optimizer = optax.adamw(learning_rate)
+        optimizer = common.adamw_with_decay_mask(learning_rate)
     defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
     defaults.update(train_param_overrides)
     return JaxExperiment(

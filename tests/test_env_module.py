@@ -1,0 +1,39 @@
+"""Task-module mapping + TB helper unit tests (reference seam:
+_env.py:10-24 custom_task_module pluggability)."""
+
+from tf_yarn_tpu import _env
+from tf_yarn_tpu.utils import tensorboard_utils
+
+
+def test_gen_task_module_defaults():
+    assert _env.gen_task_module("chief") == "tf_yarn_tpu.tasks.worker"
+    assert _env.gen_task_module("worker") == "tf_yarn_tpu.tasks.worker"
+    assert _env.gen_task_module("evaluator") == "tf_yarn_tpu.tasks.evaluator"
+    assert _env.gen_task_module("tensorboard") == "tf_yarn_tpu.tasks.tensorboard"
+
+
+def test_gen_task_module_custom_seam():
+    # custom module overrides workers but never the side-car programs.
+    assert _env.gen_task_module("worker", "my.task") == "my.task"
+    assert _env.gen_task_module("chief", "my.task") == "my.task"
+    assert _env.gen_task_module("tensorboard", "my.task") == (
+        "tf_yarn_tpu.tasks.tensorboard"
+    )
+    assert _env.gen_task_module("evaluator", "my.task") == (
+        "tf_yarn_tpu.tasks.evaluator"
+    )
+
+
+def test_tb_termination_timeout(monkeypatch):
+    monkeypatch.delenv("TB_TERMINATION_TIMEOUT_SECONDS", raising=False)
+    assert tensorboard_utils.get_termination_timeout() == 30  # default
+    monkeypatch.setenv("TB_TERMINATION_TIMEOUT_SECONDS", "120")
+    assert tensorboard_utils.get_termination_timeout() == 120
+    monkeypatch.setenv("TB_TERMINATION_TIMEOUT_SECONDS", "-1")
+    assert tensorboard_utils.get_termination_timeout() == 30  # -1 -> default
+    monkeypatch.setenv("TB_TERMINATION_TIMEOUT_SECONDS", "garbage")
+    assert tensorboard_utils.get_termination_timeout() == 30
+
+
+def test_url_event_name():
+    assert tensorboard_utils.url_event_name("tensorboard:0") == "tensorboard:0/url"

@@ -13,6 +13,12 @@ trade; a dedicated pallas backward kernel is a later optimization).
 Interface matches tf_yarn_tpu.ops.attention: q [B,S,H,D], k/v [B,Skv,Hkv,D].
 Runs in interpreter mode automatically off-TPU so the same code path is
 testable on the CPU rig.
+
+VMEM budget note: each grid step stages the full K/V sequence for one
+head in VMEM (2 * s_kv * head_dim * 2 bytes bf16) — comfortable to
+s_kv ~16k at head_dim 128 on a 16 MiB-VMEM core. Beyond that, shard the
+sequence instead (ring attention over `sp`, which calls attention on
+s_kv/sp-sized shards) or add a kv BlockSpec pipeline.
 """
 
 from __future__ import annotations

@@ -134,6 +134,32 @@ def test_chunked_lm_loss_matches_full():
     np.testing.assert_allclose(np.asarray(leaf_c), np.asarray(leaf_f), atol=2e-2)
 
 
+def test_chunked_loss_collects_moe_aux():
+    from tf_yarn_tpu.models.common import lm_loss, lm_loss_chunked
+
+    cfg = transformer.TransformerConfig.tiny(
+        moe_experts=2, scan_layers=False, remat=False
+    )
+    model = transformer.Transformer(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    import flax.linen as nn
+
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens))
+    rng = jax.random.PRNGKey(1)
+    full, aux_full = lm_loss(model, params, {"tokens": tokens}, rng)
+    chunked, aux_chunk = lm_loss_chunked(
+        model, params, {"tokens": tokens}, rng, chunk_size=100
+    )
+    assert "moe_aux_loss" in aux_full and "moe_aux_loss" in aux_chunk
+    np.testing.assert_allclose(
+        float(aux_chunk["moe_aux_loss"]), float(aux_full["moe_aux_loss"]),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(float(chunked), float(full), rtol=2e-3)
+
+
 def test_moe_transformer_trains_with_expert_parallelism():
     cfg = transformer.TransformerConfig.tiny(moe_experts=4)
     exp = transformer.make_experiment(

@@ -68,6 +68,13 @@ def lm_loss(model, params, batch, rng, train=True):
     else:
         loss = loss.mean()
     aux = {"perplexity": jnp.exp(loss)}
+    loss, aux = _apply_moe_aux(model, mod_vars, loss, aux)
+    return loss, aux
+
+
+def _apply_moe_aux(model, mod_vars, loss, aux):
+    """Fold sown MoE load-balancing losses into the task loss (shared by
+    the full and chunked LM losses)."""
     moe_weight = getattr(getattr(model, "config", None), "moe_aux_weight", 0.0)
     moe_losses = [
         jnp.sum(leaf)
@@ -91,12 +98,12 @@ def lm_loss_chunked(model, params, batch, rng, train=True, chunk_size=8192):
     of the model once; the head matmul + logsumexp run per vocab chunk
     inside a `lax.scan`, accumulating max/sum-exp online and gathering the
     target logit — O(B*S*chunk) live memory instead of O(B*S*V).
-    Same semantics as `lm_loss` (no MoE-aux collection on this path yet).
+    Same semantics as `lm_loss`, including MoE aux-loss collection.
     """
     tokens = batch["tokens"]
-    hidden = model.apply(
+    hidden, mod_vars = model.apply(
         params, tokens, rngs={"dropout": rng}, deterministic=not train,
-        return_hidden=True,
+        return_hidden=True, mutable=["intermediates"],
     )  # [B, S, D]
     head = params["params"]["lm_head"]  # [D, V]
     vocab = head.shape[-1]
@@ -152,7 +159,9 @@ def lm_loss_chunked(model, params, batch, rng, train=True, chunk_size=8192):
         loss = (loss_per_tok * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     else:
         loss = loss_per_tok.mean()
-    return loss, {"perplexity": jnp.exp(loss)}
+    aux = {"perplexity": jnp.exp(loss)}
+    loss, aux = _apply_moe_aux(model, mod_vars, loss, aux)
+    return loss, aux
 
 
 def synthetic_classification_iter(

@@ -397,9 +397,8 @@ def make_experiment(
 
     `loss_chunk_size` switches to the chunked-vocab cross-entropy
     (common.lm_loss_chunked) — set for large-vocab configs (>= ~64k) where
-    full [B, S, vocab] f32 logits dominate HBM. Defaults on automatically
-    for vocab >= 65536 unless MoE is active (the chunked path doesn't
-    collect the MoE aux loss yet)."""
+    full [B, S, vocab] f32 logits dominate HBM; defaults on automatically
+    for vocab >= 65536. MoE aux losses are collected on both paths."""
     import functools
 
     import optax
@@ -409,12 +408,7 @@ def make_experiment(
 
     config = config or TransformerConfig.tiny()
     seq_len = seq_len or config.max_seq_len
-    if loss_chunk_size and config.moe_experts:
-        raise ValueError(
-            "loss_chunk_size is incompatible with MoE configs: the chunked "
-            "loss does not collect the MoE aux loss yet"
-        )
-    if loss_chunk_size is None and config.vocab_size >= 65536 and not config.moe_experts:
+    if loss_chunk_size is None and config.vocab_size >= 65536:
         loss_chunk_size = 16384
     loss_fn = (
         functools.partial(common.lm_loss_chunked, chunk_size=loss_chunk_size)

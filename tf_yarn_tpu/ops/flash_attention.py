@@ -14,11 +14,12 @@ Interface matches tf_yarn_tpu.ops.attention: q [B,S,H,D], k/v [B,Skv,Hkv,D].
 Runs in interpreter mode automatically off-TPU so the same code path is
 testable on the CPU rig.
 
-VMEM budget note: each grid step stages the full K/V sequence for one
-head in VMEM (2 * s_kv * head_dim * 2 bytes bf16) — comfortable to
-s_kv ~16k at head_dim 128 on a 16 MiB-VMEM core. Beyond that, shard the
-sequence instead (ring attention over `sp`, which calls attention on
-s_kv/sp-sized shards) or add a kv BlockSpec pipeline.
+VMEM budget: O(block_q * (block_k + head_dim)) — the kv dimension is a
+grid axis, so pallas streams one (block_k, head_dim) K/V tile at a time
+into VMEM (double-buffered by the pipeline) while the online-softmax
+state lives in VMEM scratch across kv steps. Sequence length is bounded
+by HBM, not VMEM; for sequences beyond one chip entirely, use ring
+attention over `sp`.
 """
 
 from __future__ import annotations
@@ -33,26 +34,33 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  softmax_scale: float):
-    """One q-block vs all kv-blocks. Refs carry a leading block dim of 1:
-    q (1, block_q, d), k/v (1, s_kv, d), o (1, block_q, d).
-    Grid: (batch*heads, s_q // block_q)."""
-    _, block_q, head_dim = q_ref.shape
-    s_kv = k_ref.shape[1]
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, softmax_scale: float):
+    """One (q-block, kv-block) tile. Grid: (batch*heads, q_blocks,
+    kv_blocks) with the kv dimension innermost — pallas streams one kv
+    block at a time into VMEM (BlockSpec pipelining) while the online-
+    softmax state persists in VMEM scratch across kv steps. Refs carry a
+    leading block dim of 1: q (1, bq, d), k/v (1, bk, d), o (1, bq, d)."""
     q_block_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * softmax_scale
+    kv_idx = pl.program_id(2)
+    num_kv_blocks = pl.num_programs(2)
+    _, block_q, head_dim = q_ref.shape
+    block_k = k_ref.shape[1]
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_kv_blocks = s_kv // block_k
+    # Causal: kv blocks strictly after this q block are fully masked.
+    live = True if not causal else kv_idx * block_k <= (q_block_idx + 1) * block_q - 1
 
-    def body(kv_idx, carry):
-        m_prev, l_prev, acc_prev = carry
-        k_blk = k_ref[0, pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kv_idx * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * softmax_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -67,29 +75,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             )
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_prev = m_scr[...]
         m_blk = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(logits - m_new)
         correction = jnp.exp(m_prev - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_prev * correction + jax.lax.dot_general(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
 
-    if causal:
-        # kv blocks strictly after this q block are fully masked: skip
-        # them. Last useful block j satisfies j*block_k <= q_end, i.e.
-        # upper = ceil((q_block_idx+1)*block_q / block_k).
-        upper = jnp.minimum(
-            num_kv_blocks,
-            ((q_block_idx + 1) * block_q + block_k - 1) // block_k,
+    @pl.when(kv_idx == num_kv_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype
         )
-    else:
-        upper = num_kv_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_forward(
@@ -122,19 +124,29 @@ def _flash_forward(
 
     qb, kb, vb = to_bh(query), to_bh(key), to_bh(value)
 
+    from jax.experimental.pallas import tpu as pltpu
+
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, softmax_scale=softmax_scale
+        _flash_kernel, causal=causal, softmax_scale=softmax_scale
     )
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, head_dim), jnp.float32),
+    ]
     out = pl.pallas_call(
         kernel,
-        grid=(b * n_heads, s_q // block_q),
+        grid=(b * n_heads, s_q // block_q, s_kv // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, s_kv, head_dim), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, s_kv, head_dim), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)
+        ),
         out_shape=jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(qb, kb, vb)
     return out.reshape(b, n_heads, s_q, head_dim).transpose(0, 2, 1, 3)

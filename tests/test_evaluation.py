@@ -54,6 +54,34 @@ def test_continuous_eval_skips_already_evaluated(tmp_path):
     assert metrics == {}
 
 
+def test_continuous_eval_runs_exporters(tmp_path):
+    experiment = _train_with_ckpts(tmp_path)
+    exported = []
+    # A list of exporters, like the reference API.
+    experiment.exporters = [
+        lambda params, metrics, step: exported.append((step, sorted(metrics))),
+        lambda params, metrics, step: exported.append(("second", step)),
+    ]
+    evaluation.continuous_eval(None, experiment, poll_secs=0.1, idle_timeout_secs=5.0)
+    assert [s for s, _ in exported if s != "second"] == [5, 10]
+    assert [s for tag, s in exported if tag == "second"] == [5, 10]
+
+
+def test_continuous_eval_exporter_failure_does_not_kill_loop(tmp_path):
+    experiment = _train_with_ckpts(tmp_path)
+
+    def broken(params, metrics, step):
+        raise RuntimeError("export target unavailable")
+
+    experiment.exporters = broken
+    metrics = evaluation.continuous_eval(
+        None, experiment, poll_secs=0.1, idle_timeout_secs=5.0
+    )
+    # Both checkpoints still evaluated despite the failing exporter.
+    assert evaluation._evaluated_steps(str(tmp_path)) == {5, 10}
+    assert np.isfinite(metrics["loss"])
+
+
 def test_continuous_eval_idle_timeout(tmp_path):
     # No final checkpoint appears (train_steps larger than what exists):
     # the evaluator must give up after the idle timeout.

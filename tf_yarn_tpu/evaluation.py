@@ -103,6 +103,15 @@ def continuous_eval(
     eval_step = jax.jit(build_eval_step(core.model, core.loss_fn))
     rng = jax.random.PRNGKey(core.train_params.seed)
 
+    # One callable or a sequence of them (the reference's exporters is a
+    # list, evaluator_task.py:103-121).
+    if core.exporters is None:
+        exporter_fns = []
+    elif callable(core.exporters):
+        exporter_fns = [core.exporters]
+    else:
+        exporter_fns = list(core.exporters)
+
     done = _evaluated_steps(core.model_dir)
     final_step = core.train_params.train_steps
     last_metrics: dict = {}
@@ -165,6 +174,13 @@ def continuous_eval(
             elapsed = time.time() - t0
             awake_time += elapsed
             nb_eval_steps += consumed["n"]
+            for exporter in exporter_fns:
+                # Post-eval export hooks (reference: eval_spec.exporters
+                # run after each evaluation, evaluator_task.py:103-121).
+                try:
+                    exporter(params, metrics, step)
+                except Exception:
+                    _logger.exception("exporter failed for ckpt-%d", step)
             last_metrics = metrics
             done.add(step)
             last_new = time.time()

@@ -67,6 +67,9 @@ class JaxExperiment:
     eval_input_fn: Optional[InputFn] = None
     init_fn: Optional[Callable] = None
     mesh_spec: Optional[MeshSpec] = None
+    # exporters(params, metrics, step): run by the side-car evaluator
+    # after each checkpoint's evaluation.
+    exporters: Optional[Callable] = None
 
 
 class Estimator:
@@ -135,6 +138,11 @@ class EvalSpec(NamedTuple):
     throttle_secs: int = 30  # side-car evaluator poll cadence
     start_delay_secs: int = 0
     every_steps: Optional[int] = None  # in-loop eval cadence (None = end only)
+    # Called by the side-car evaluator after each checkpoint's evaluation:
+    # exporters(params, metrics, step) — the reference's
+    # eval_spec.exporters hook (evaluator_task.py:103-121), e.g. to write
+    # a serving copy of the best weights.
+    exporters: Optional[Callable] = None
 
 
 class ExperimentSpec(NamedTuple):
@@ -186,6 +194,8 @@ class CoreExperiment:
     eval_input_fn: Optional[InputFn]
     init_fn: Optional[Callable]
     mesh_spec: Optional[MeshSpec]
+    # exporters(params, metrics, step): evaluator post-eval hook.
+    exporters: Optional[Callable] = None
 
 
 def _merge_input_targets(experiment: KerasExperiment) -> InputFn:
@@ -217,6 +227,7 @@ def as_core_experiment(experiment: Any) -> CoreExperiment:
             eval_input_fn=experiment.eval_input_fn,
             init_fn=experiment.init_fn,
             mesh_spec=experiment.mesh_spec,
+            exporters=experiment.exporters,
         )
     if isinstance(experiment, ExperimentSpec):
         estimator = experiment.estimator
@@ -236,6 +247,7 @@ def as_core_experiment(experiment: Any) -> CoreExperiment:
             eval_input_fn=eval_spec.input_fn if eval_spec else None,
             init_fn=estimator.init_fn,
             mesh_spec=estimator.mesh_spec,
+            exporters=eval_spec.exporters if eval_spec else None,
         )
     if isinstance(experiment, KerasExperiment):
         return CoreExperiment(

@@ -148,6 +148,15 @@ def _flash_forward(
         out_shape=jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype),
         scratch_shapes=scratch,
         interpret=interpret,
+        # Megacore: heads and q blocks parallelize across cores; the kv
+        # axis is a sequential reduction (scratch accumulation).
+        compiler_params=(
+            None
+            if interpret
+            else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")
+            )
+        ),
     )(qb, kb, vb)
     return out.reshape(b, n_heads, s_q, head_dim).transpose(0, 2, 1, 3)
 

@@ -57,6 +57,12 @@ class TransformerConfig:
     # Fused pallas RMSNorm (ops/rmsnorm.py). Opt-in: best on single-chip /
     # shard_map paths; under pjit the XLA-fused norm already performs well.
     fused_norms: bool = False
+    # GPipe schedule for the layer stack over the pp mesh axis: >0 sets the
+    # microbatch count and routes the blocks through
+    # parallel.pipeline.pipeline_apply (overlapped stages) instead of the
+    # naive layer-sharded scan. Requires scan_layers=True, n_layers % pp
+    # == 0, batch % microbatches == 0; train-path only (no decode/MoE).
+    gpipe_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -292,6 +298,26 @@ class _ScanBody(nn.Module):
         )
 
 
+def _make_scanned(cfg: TransformerConfig):
+    """The lifted layer-stack constructor, shared by the scan path and the
+    GPipe path's init so both produce byte-identical param structure and
+    sharding metadata (checkpoint interchangeability between schedules).
+
+    intermediates rides along stacked so sown values (MoE aux loss)
+    survive the scan lift; cache likewise stacks each layer's KV cache
+    for decoding. The "layers" partition name maps the stacked axis onto
+    the pp mesh axis (parallel.sharding.LOGICAL_RULES).
+    """
+    return nn.scan(
+        _ScanBody,
+        variable_axes={"params": 0, "intermediates": 0, "cache": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=nn.broadcast,
+        length=cfg.n_layers,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )
+
+
 class Transformer(nn.Module):
     """tokens [B, S] int32 -> logits [B, S, vocab].
 
@@ -319,22 +345,10 @@ class Transformer(nn.Module):
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
 
-        if cfg.scan_layers:
-            scanned = nn.scan(
-                _ScanBody,
-                # intermediates rides along stacked so sown values (MoE aux
-                # loss) survive the scan lift; cache likewise stacks each
-                # layer's KV cache for decoding.
-                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
-                split_rngs={"params": True, "dropout": True},
-                in_axes=nn.broadcast,
-                length=cfg.n_layers,
-                # Logical name for the stacked-layer axis: maps to the pp
-                # mesh axis (parallel.sharding.LOGICAL_RULES), so a pp>1
-                # mesh shards whole layers across pipeline stages.
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = scanned(cfg, decode, name="layers")(x, positions)
+        if cfg.gpipe_microbatches > 0 and not decode:
+            x = self._gpipe_layers(x, positions)
+        elif cfg.scan_layers:
+            x, _ = _make_scanned(cfg)(cfg, decode, name="layers")(x, positions)
         else:
             for i in range(cfg.n_layers):
                 x = _ScanBody(cfg, decode, name=f"layer_{i}")(x, positions)[0]
@@ -349,6 +363,75 @@ class Transformer(nn.Module):
         if return_hidden:
             return x
         return jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype)).astype(jnp.float32)
+
+    def _gpipe_layers(self, x, positions):
+        """Layer stack under the overlapped GPipe schedule
+        (parallel.pipeline.pipeline_apply over the pp mesh axis).
+
+        Parameters are created by (and stored identically to) the scan
+        path — init runs the scanned blocks once — so checkpoints are
+        interchangeable between schedules.
+        """
+        cfg = self.config
+        if not cfg.scan_layers:
+            raise ValueError("gpipe_microbatches requires scan_layers=True")
+        if cfg.moe_experts or cfg.attention_impl != "xla":
+            raise ValueError(
+                "gpipe_microbatches supports dense blocks with xla attention"
+            )
+        scanned = _make_scanned(cfg)
+        if self.is_initializing():
+            # Creates the stacked "layers" params; init output is unused
+            # beyond shapes, so the schedule difference is irrelevant.
+            x, _ = scanned(cfg, False, name="layers")(x, positions)
+            return x
+
+        from tf_yarn_tpu.parallel.mesh import AXIS_PP, current_mesh
+        from tf_yarn_tpu.parallel.pipeline import pipeline_apply
+
+        mesh = current_mesh()
+        if mesh is None:
+            x, _ = scanned(cfg, False, name="layers")(x, positions)
+            return x
+        pp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_PP, 1)
+        if cfg.n_layers % pp:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide over pp={pp} stages"
+            )
+        layer_params = self.get_variable("params", "layers")
+        layers_per_stage = cfg.n_layers // pp
+        stage_params = jax.tree_util.tree_map(
+            lambda p: p.reshape(pp, layers_per_stage, *p.shape[1:]),
+            layer_params,
+        )
+
+        # One row of positions broadcasts over any microbatch size (the
+        # full [B, S] array would smuggle the global batch dim into the
+        # microbatch-local stage compute).
+        positions_row = positions[:1]
+
+        def stage_fn(params_slice, h):
+            def layer_body(carry, layer_p):
+                out = Block(cfg).apply(
+                    {"params": layer_p["block"]}, carry, positions_row
+                )
+                return out, None
+
+            if cfg.remat:
+                # Same activation-memory trade as the scan path: recompute
+                # each layer in backward instead of keeping every in-flight
+                # microbatch's full activations.
+                layer_body = jax.checkpoint(
+                    layer_body,
+                    policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                )
+            h, _ = jax.lax.scan(layer_body, h, params_slice)
+            return h
+
+        return pipeline_apply(
+            stage_fn, stage_params, x, mesh,
+            num_microbatches=cfg.gpipe_microbatches,
+        )
 
 
 def lora_label_tree(params) -> Any:

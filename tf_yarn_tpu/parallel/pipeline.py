@@ -119,6 +119,16 @@ def pipeline_apply(
             f"batch {batch} not divisible by num_microbatches {num_microbatches}"
         )
     mb = batch // num_microbatches
+    data_shards = 1
+    for axis in batch_axes:
+        data_shards *= axis_sizes.get(axis, 1)
+    if mb % data_shards:
+        raise ValueError(
+            f"microbatch size {mb} (= batch {batch} / {num_microbatches} "
+            f"microbatches) must be a multiple of the data sharding "
+            f"{data_shards} (product of mesh axes {batch_axes}) — use fewer "
+            "microbatches or a larger batch"
+        )
     x_micro = x.reshape(num_microbatches, mb, *x.shape[1:])
 
     present_batch_axes = tuple(

@@ -40,15 +40,60 @@ def test_flash_indivisible_seq_rejected():
         flash_attention(q, k, v, block_q=32, block_k=32)
 
 
-def test_flash_backward_runs():
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_matches_xla(causal):
     q, k, v = _qkv(s=32)
 
     def loss(q, k, v):
-        return flash_attention(q, k, v, causal=True, block_q=16, block_k=16).sum()
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        return (out * jnp.cos(out)).sum()  # non-trivial cotangent
 
     grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     ref_grads = jax.grad(
-        lambda q, k, v: xla_attention(q, k, v, causal=True).sum(), argnums=(0, 1, 2)
+        lambda q, k, v: (lambda o: (o * jnp.cos(o)).sum())(
+            xla_attention(q, k, v, causal=causal)
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+def test_flash_backward_gqa():
+    q, k, v = _qkv(s=64, h=8, hkv=2)
+
+    def loss(fn):
+        def inner(q, k, v):
+            return fn(q, k, v).sum()
+        return inner
+
+    grads = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=32, block_k=32)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    ref_grads = jax.grad(
+        loss(lambda q, k, v: xla_attention(q, k, v, causal=True)), argnums=(0, 1, 2)
+    )(q, k, v)
+    for g, r in zip(grads, ref_grads):
+        assert g.shape == r.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+def test_flash_backward_cross_lengths():
+    # s_q != s_kv, non-causal (encoder-decoder shape).
+    rng = np.random.RandomState(3)
+    mk = lambda *shape: jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.3)
+    q, k, v = mk(2, 32, 4, 16), mk(2, 64, 4, 16), mk(2, 64, 4, 16)
+
+    grads = jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=False, block_q=16, block_k=32
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    ref_grads = jax.grad(
+        lambda q, k, v: xla_attention(q, k, v, causal=False).sum(),
+        argnums=(0, 1, 2),
     )(q, k, v)
     for g, r in zip(grads, ref_grads):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)

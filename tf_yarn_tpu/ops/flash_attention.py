@@ -1,4 +1,4 @@
-"""Flash attention — pallas TPU kernel with blockwise online softmax.
+"""Flash attention — pallas TPU kernels, forward and backward.
 
 The HBM-bandwidth-saving attention for long sequences: logits are never
 materialized in HBM; each (q-block, kv-block) tile lives in VMEM with
@@ -6,20 +6,32 @@ running max / sum-exp / output accumulators carried across kv blocks
 (per /opt/skills/guides/pallas_guide.md: grid+BlockSpec tiling, f32
 accumulation, MXU dots with preferred_element_type).
 
-Backward runs through a custom VJP that recomputes attention with the XLA
-reference implementation (rematerialization: the standard FLOPs-for-HBM
-trade; a dedicated pallas backward kernel is a later optimization).
+GQA is handled inside the BlockSpec index maps — the kv operands stay in
+their native [B, S_kv, H_kv, D] shape and each q head reads its kv head
+via ``bh // group``; K/V HBM traffic is never multiplied by H/H_kv.
 
-Interface matches tf_yarn_tpu.ops.attention: q [B,S,H,D], k/v [B,Skv,Hkv,D].
-Runs in interpreter mode automatically off-TPU so the same code path is
-testable on the CPU rig.
+Backward is two pallas kernels (dq, then a fused dk/dv) that recompute
+the attention probabilities blockwise from the forward's saved
+log-sum-exp — the standard FLOPs-for-HBM trade; the full [B,H,S,S]
+logits never exist in HBM in either direction. The dk/dv kernel
+accumulates over every q head of a GQA group in VMEM scratch, so dk/dv
+are produced directly in the [B, S_kv, H_kv, D] shape.
 
-VMEM budget: O(block_q * (block_k + head_dim)) — the kv dimension is a
-grid axis, so pallas streams one (block_k, head_dim) K/V tile at a time
-into VMEM (double-buffered by the pipeline) while the online-softmax
-state lives in VMEM scratch across kv steps. Sequence length is bounded
-by HBM, not VMEM; for sequences beyond one chip entirely, use ring
-attention over `sp`.
+Layout notes (Mosaic-proven patterns, cf. jax.experimental.pallas.ops.tpu):
+* online-softmax stats and the saved LSE are lane-replicated to
+  (block_q, 128) — keeps every read/write layout-native, at the price of
+  a 128x-replicated f32 LSE residual in HBM (B*H*S*512 bytes);
+* causal skipping selects the *next live* block in the index map so the
+  pipeline never prefetches a tile that pl.when will discard.
+
+Interface matches tf_yarn_tpu.ops.attention: q [B,S,H,D], k/v
+[B,Skv,Hkv,D]. Runs in interpreter mode automatically off-TPU so the
+same code path is testable on the CPU rig.
+
+VMEM budget: O(block_q * (block_k + head_dim)) forward; the backward
+dk/dv kernel additionally carries (block_k, head_dim) f32 accumulators.
+Sequence length is bounded by HBM, not VMEM; for sequences beyond one
+chip entirely, use ring attention over `sp`.
 """
 
 from __future__ import annotations
@@ -29,22 +41,54 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+_STAT_LANES = 128  # lane replication for online-softmax stats / LSE
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, softmax_scale: float):
+def _block_live(qi, ki, block_q, block_k):
+    """Causal liveness of a (q-block, kv-block) tile: the kv block starts
+    at or before the q block's last row."""
+    return ki * block_k < (qi + 1) * block_q
+
+
+def _kv_index_map(causal, block_q, block_k, group):
+    """kv BlockSpec index map for (bh, qi, ki) grids: GQA head mapping,
+    plus causal skip-prefetch (dead blocks point at block 0 so the
+    pipeline never fetches a tile pl.when will discard)."""
+    def kv_idx(bh, qi, ki):
+        if causal:
+            ki = lax.select(_block_live(qi, ki, block_q, block_k), ki, 0)
+        return (bh // group, ki, 0)
+    return kv_idx
+
+
+def _causal_mask(logits, q_start, k_start):
+    block_q, block_k = logits.shape
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, logits, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                causal: bool, softmax_scale: float):
     """One (q-block, kv-block) tile. Grid: (batch*heads, q_blocks,
     kv_blocks) with the kv dimension innermost — pallas streams one kv
     block at a time into VMEM (BlockSpec pipelining) while the online-
     softmax state persists in VMEM scratch across kv steps. Refs carry a
-    leading block dim of 1: q (1, bq, d), k/v (1, bk, d), o (1, bq, d)."""
+    leading block dim of 1: q (1, bq, d), k/v (1, bk, d), o (1, bq, d);
+    stats are lane-replicated (bq, 128)."""
     q_block_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
     num_kv_blocks = pl.num_programs(2)
-    _, block_q, head_dim = q_ref.shape
+    _, block_q, _ = q_ref.shape
     block_k = k_ref.shape[1]
 
     @pl.when(kv_idx == 0)
@@ -54,44 +98,56 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Causal: kv blocks strictly after this q block are fully masked.
-    live = True if not causal else kv_idx * block_k <= (q_block_idx + 1) * block_q - 1
+    live = True if not causal else _block_live(q_block_idx, kv_idx, block_q, block_k)
 
     @pl.when(live)
     def _step():
         q = q_ref[0].astype(jnp.float32) * softmax_scale
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
-        logits = jax.lax.dot_general(
+        logits = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
-            q_pos = (
-                q_block_idx * block_q
-                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            )
-            k_pos = (
-                kv_idx * block_k
-                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            )
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+            logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
         m_prev = m_scr[...]
-        m_blk = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(logits - m_new)
-        correction = jnp.exp(m_prev - m_new)
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_blk, m_prev.shape))
+        p = jnp.exp(logits - m_new[:, :1])
+        correction = jnp.exp(m_prev - m_new)  # (bq, 128) replicated
         m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
+        l_scr[...] = l_scr[...] * correction + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), m_prev.shape
+        )
+        acc_scr[...] = acc_scr[...] * correction[:, :1] + lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     @pl.when(kv_idx == num_kv_blocks - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
-            o_ref.dtype
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = m_scr[...] + jnp.log(l)
+
+
+def _check_blocks(s_q, s_kv, block_q, block_k):
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"flash attention needs seq lengths divisible by blocks: "
+            f"s_q={s_q} %% {block_q}, s_kv={s_kv} %% {block_k}"
         )
+    return block_q, block_k
+
+
+def _to_bh(x):
+    """[B, S, H, D] -> [B*H, S, D]."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
 def _flash_forward(
@@ -103,49 +159,52 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
-    from tf_yarn_tpu.ops.attention import _repeat_kv
+    save_residuals: bool,
+):
+    from jax.experimental.pallas import tpu as pltpu
 
     b, s_q, n_heads, head_dim = query.shape
     _, s_kv, n_kv, _ = key.shape
-    key, value = _repeat_kv(key, value, n_heads // n_kv)
+    group = n_heads // n_kv
+    block_q, block_k = _check_blocks(s_q, s_kv, block_q, block_k)
 
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_kv)
-    if s_q % block_q or s_kv % block_k:
-        raise ValueError(
-            f"flash attention needs seq lengths divisible by blocks: "
-            f"s_q={s_q} %% {block_q}, s_kv={s_kv} %% {block_k}"
-        )
+    qb, kb, vb = _to_bh(query), _to_bh(key), _to_bh(value)
 
-    # [B,S,H,D] -> [B*H, S, D]
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * n_heads, x.shape[1], head_dim)
-
-    qb, kb, vb = to_bh(query), to_bh(key), to_bh(value)
-
-    from jax.experimental.pallas import tpu as pltpu
+    kv_idx = _kv_index_map(causal, block_q, block_k, group)
 
     kernel = functools.partial(
-        _flash_kernel, causal=causal, softmax_scale=softmax_scale
+        _fwd_kernel, causal=causal, softmax_scale=softmax_scale
     )
     scratch = [
-        pltpu.VMEM((block_q, 1), jnp.float32),
-        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+        pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
         pltpu.VMEM((block_q, head_dim), jnp.float32),
     ]
-    out = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype)]
+    out_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0))
+    ]
+    if save_residuals:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * n_heads, s_q, _STAT_LANES), jnp.float32)
+        )
+        out_specs.append(
+            pl.BlockSpec((1, block_q, _STAT_LANES), lambda bh, qi, ki: (bh, qi, 0))
+        )
+    else:
+        out_shape.append(None)
+        out_specs.append(None)
+
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * n_heads, s_q // block_q, s_kv // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((1, block_k, head_dim), kv_idx),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
         # Megacore: heads and q blocks parallelize across cores; the kv
@@ -158,38 +217,247 @@ def _flash_forward(
             )
         ),
     )(qb, kb, vb)
-    return out.reshape(b, n_heads, s_q, head_dim).transpose(0, 2, 1, 3)
+    out = out.reshape(b, n_heads, s_q, head_dim).transpose(0, 2, 1, 3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal: bool, softmax_scale: float):
+    """dq for one q block, accumulated across the (innermost) kv axis.
+    Grid: (batch*heads, q_blocks, kv_blocks)."""
+    q_block_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+    num_kv_blocks = pl.num_programs(2)
+    _, block_q, _ = q_ref.shape
+    block_k = k_ref.shape[1]
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = True if not causal else _block_live(q_block_idx, kv_idx, block_q, block_k)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * softmax_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        logits = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
+        p = jnp.exp(logits - lse_ref[0][:, :1])
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_scr[...] += lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kv_idx == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[...] * softmax_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
+                causal: bool, softmax_scale: float, q_blocks: int,
+                block_q: int):
+    """dk/dv for one kv block of one *kv* head, accumulated across the
+    (innermost) flattened (group, q_block) axis — every q head of the GQA
+    group lands in the same VMEM accumulator, so dk/dv come out in the
+    native [B*Hkv, Skv, D] shape with no host-side group reduction.
+    Grid: (batch*kv_heads, kv_blocks, group*q_blocks)."""
+    kv_idx = pl.program_id(1)
+    j = pl.program_id(2)
+    num_j = pl.num_programs(2)
+    q_block_idx = j % q_blocks
+    block_k = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = True if not causal else _block_live(q_block_idx, kv_idx, block_q, block_k)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * softmax_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        logits = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
+        p = jnp.exp(logits - lse_ref[0, 0][:, :1])  # (bq, bk)
+        dv_scr[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        # q here is pre-scaled, so ds^T @ q == softmax_scale * ds^T @ q_raw.
+        dk_scr[...] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+
+    @pl.when(j == num_j - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    query, key, value, out, lse, g,
+    causal: bool, softmax_scale: float,
+    block_q: int, block_k: int, interpret: bool,
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, n_heads, head_dim = query.shape
+    _, s_kv, n_kv, _ = key.shape
+    group = n_heads // n_kv
+    block_q, block_k = _check_blocks(s_q, s_kv, block_q, block_k)
+    q_blocks, kv_blocks = s_q // block_q, s_kv // block_k
+
+    qb, kb, vb = _to_bh(query), _to_bh(key), _to_bh(value)
+    dob, ob = _to_bh(g), _to_bh(out)
+    # delta_i = rowsum(dO * O): elementwise, XLA fuses it; replicate to the
+    # stat-lane layout the kernels read natively.
+    delta = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1)
+    delta = lax.broadcast_in_dim(
+        delta, (b * n_heads, s_q, _STAT_LANES), (0, 1)
+    )
+
+    sem = (
+        None
+        if interpret
+        else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    )
+
+    # --- dq: grid (B*H, q_blocks, kv_blocks), kv innermost ---
+    kv_idx = _kv_index_map(causal, block_q, block_k, group)
+
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda bh, qi, ki: (bh, qi, 0))
+    stat_spec = pl.BlockSpec(
+        (1, block_q, _STAT_LANES), lambda bh, qi, ki: (bh, qi, 0)
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, softmax_scale=softmax_scale
+        ),
+        grid=(b * n_heads, q_blocks, kv_blocks),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, head_dim), kv_idx),
+            pl.BlockSpec((1, block_k, head_dim), kv_idx),
+            q_spec,
+            stat_spec,
+            stat_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * n_heads, s_q, head_dim), query.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+        compiler_params=sem,
+    )(qb, kb, vb, dob, lse, delta)
+
+    # --- dk/dv: grid (B*Hkv, kv_blocks, group*q_blocks), q innermost ---
+    # q-side operands viewed as [B*Hkv, group, Sq, ...]: pure reshape, since
+    # q head h maps to kv head h // group.
+    q4 = qb.reshape(b * n_kv, group, s_q, head_dim)
+    do4 = dob.reshape(b * n_kv, group, s_q, head_dim)
+    lse4 = lse.reshape(b * n_kv, group, s_q, _STAT_LANES)
+    delta4 = delta.reshape(b * n_kv, group, s_q, _STAT_LANES)
+
+    def q4_idx(bh, ki, j):
+        g, qi = j // q_blocks, j % q_blocks
+        if causal:
+            # Skip dead early q blocks: prefetch the first live one instead.
+            qi = lax.select(_block_live(qi, ki, block_q, block_k), qi,
+                            ki * block_k // block_q)
+        return (bh, g, qi, 0)
+
+    kv_spec = pl.BlockSpec((1, block_k, head_dim), lambda bh, ki, j: (bh, ki, 0))
+    q4_spec = pl.BlockSpec((1, 1, block_q, head_dim), q4_idx)
+    stat4_spec = pl.BlockSpec((1, 1, block_q, _STAT_LANES), q4_idx)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, softmax_scale=softmax_scale,
+            q_blocks=q_blocks, block_q=block_q,
+        ),
+        grid=(b * n_kv, kv_blocks, group * q_blocks),
+        in_specs=[q4_spec, kv_spec, kv_spec, q4_spec, stat4_spec, stat4_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n_kv, s_kv, head_dim), key.dtype),
+            jax.ShapeDtypeStruct((b * n_kv, s_kv, head_dim), value.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=sem,
+    )(q4, kb, vb, do4, lse4, delta4)
+
+    def from_bh(x, h):
+        return x.reshape(b, h, x.shape[1], head_dim).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, n_heads), from_bh(dk, n_kv), from_bh(dv, n_kv)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
-    return _flash_forward(
-        query, key, value, causal, softmax_scale, block_q, block_k, interpret
+    out, _ = _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k, interpret,
+        save_residuals=False,
     )
+    return out
 
 
 def _flash_fwd(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
-    out = _flash_forward(
-        query, key, value, causal, softmax_scale, block_q, block_k, interpret
+    out, lse = _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k, interpret,
+        save_residuals=True,
     )
-    return out, (query, key, value)
+    return out, (query, key, value, out, lse)
 
 
 def _flash_bwd(causal, softmax_scale, block_q, block_k, interpret, residuals, g):
-    from tf_yarn_tpu.ops.attention import xla_attention
-
-    query, key, value = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: xla_attention(
-            q, k, v, causal=causal, softmax_scale=softmax_scale
-        ),
-        query,
-        key,
-        value,
+    query, key, value, out, lse = residuals
+    return _flash_backward(
+        query, key, value, out, lse, g,
+        causal, softmax_scale, block_q, block_k, interpret,
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -206,7 +474,8 @@ def flash_attention(
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Blockwise (flash) attention; differentiable via recompute-backward."""
+    """Blockwise (flash) attention, differentiable via pallas backward
+    kernels that recompute probabilities from the saved log-sum-exp."""
     if softmax_scale is None:
         softmax_scale = query.shape[-1] ** -0.5
     if interpret is None:

@@ -79,20 +79,22 @@ def test_flash_backward_gqa():
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
 
 
-def test_flash_backward_cross_lengths():
-    # s_q != s_kv, non-causal (encoder-decoder shape).
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_cross_lengths(causal):
+    # s_q != s_kv (encoder-decoder shape); the causal case has kv blocks
+    # entirely beyond the last q row (dead-block index clamping).
     rng = np.random.RandomState(3)
     mk = lambda *shape: jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.3)
     q, k, v = mk(2, 32, 4, 16), mk(2, 64, 4, 16), mk(2, 64, 4, 16)
 
     grads = jax.grad(
         lambda q, k, v: flash_attention(
-            q, k, v, causal=False, block_q=16, block_k=32
+            q, k, v, causal=causal, block_q=16, block_k=32
         ).sum(),
         argnums=(0, 1, 2),
     )(q, k, v)
     ref_grads = jax.grad(
-        lambda q, k, v: xla_attention(q, k, v, causal=False).sum(),
+        lambda q, k, v: xla_attention(q, k, v, causal=causal).sum(),
         argnums=(0, 1, 2),
     )(q, k, v)
     for g, r in zip(grads, ref_grads):

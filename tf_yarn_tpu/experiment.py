@@ -41,6 +41,9 @@ class TrainParams:
     eval_every_steps: Optional[int] = None
     eval_steps: int = 10
     checkpoint_every_steps: Optional[int] = None
+    # Completed checkpoints beyond the newest N are deleted (Estimator
+    # keep_max semantics). None = keep everything.
+    keep_last_n: Optional[int] = 5
     log_every_steps: int = 10
     seed: int = 0
     # Split each global batch into N sequential microbatches, averaging

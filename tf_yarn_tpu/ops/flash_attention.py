@@ -395,8 +395,10 @@ def _flash_backward(
         g, qi = j // q_blocks, j % q_blocks
         if causal:
             # Skip dead early q blocks: prefetch the first live one instead.
+            # Clamp: with s_kv > s_q a kv block can sit beyond the last q
+            # row entirely, so the "first live q block" must stay in range.
             qi = lax.select(_block_live(qi, ki, block_q, block_k), qi,
-                            ki * block_k // block_q)
+                            jnp.minimum(ki * block_k // block_q, q_blocks - 1))
         return (bh, g, qi, 0)
 
     kv_spec = pl.BlockSpec((1, block_k, head_dim), lambda bh, ki, j: (bh, ki, 0))

@@ -23,6 +23,7 @@ TPU-first design points:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -274,17 +275,22 @@ def train_and_evaluate(
     abstract_boxed = jax.eval_shape(init_state_boxed, init_rng, first_global)
     state_shardings = _named_shardings(mesh, abstract_boxed)
 
-    with mesh:
+    with mesh, contextlib.ExitStack() as _cleanup:
         init_jit = jax.jit(init_state, out_shardings=state_shardings)
         state = init_jit(init_rng, first_global)
 
         resume_step = 0
+        ckpt_writer = None
         if core.model_dir:
             restored, step = ckpt_lib.restore_latest(core.model_dir, target=state)
             if restored is not None:
                 state = restored
                 resume_step = int(step)
                 _logger.info("resumed from checkpoint step %d", resume_step)
+            # Async writer: save() returns once the state is snapshotted to
+            # host; serialization+commit overlap the next train steps.
+            ckpt_writer = ckpt_lib.CheckpointWriter(params_cfg.keep_last_n)
+            _cleanup.callback(ckpt_writer.close)
 
         train_step = jax.jit(
             build_train_step(
@@ -336,7 +342,7 @@ def train_and_evaluate(
                     and step % params_cfg.checkpoint_every_steps == 0
                     and core.model_dir
                 ):
-                    ckpt_lib.save_checkpoint(core.model_dir, step, state)
+                    ckpt_writer.save(core.model_dir, step, state)
                 if (
                     params_cfg.eval_every_steps
                     and core.eval_input_fn
@@ -373,7 +379,8 @@ def train_and_evaluate(
                 k: float(v) for k, v in eval_step(state, batch, train_rng).items()
             }
         if core.model_dir:
-            ckpt_lib.save_checkpoint(core.model_dir, step, state)
+            ckpt_writer.save(core.model_dir, step, state)
+            ckpt_writer.wait()
         if core.eval_input_fn:
             final_eval = evaluate(
                 eval_step, state, core.eval_input_fn, globalize,

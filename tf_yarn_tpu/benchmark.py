@@ -35,6 +35,7 @@ def measure_throughput(
     import numpy as np
 
     from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+    from tf_yarn_tpu.utils import flops as flops_lib
     from tf_yarn_tpu.parallel.sharding import tree_shardings, unbox_params
     from tf_yarn_tpu.training import TrainState, build_train_step
 
@@ -68,13 +69,13 @@ def measure_throughput(
         abstract = jax.eval_shape(init_boxed, rng, placed)
         shardings = tree_shardings(mesh, abstract)
         state = jax.jit(init_state, out_shardings=shardings)(rng, placed)
+        t0 = time.time()
         step_fn = jax.jit(
             build_train_step(model, loss_fn, optimizer),
             donate_argnums=(0,),
             out_shardings=(shardings, None),
-        )
-
-        t0 = time.time()
+        ).lower(state, placed, rng).compile()
+        flops_per_step = flops_lib.compiled_flops(step_fn)
         for _ in range(warmup):
             state, metrics = step_fn(state, placed, rng)
         jax.block_until_ready(state.params)
@@ -87,7 +88,7 @@ def measure_throughput(
         elapsed = time.time() - t0
 
     samples_per_sec = steps * batch_size / elapsed
-    return {
+    result = {
         "samples_per_sec": samples_per_sec,
         "samples_per_sec_per_chip": samples_per_sec / len(devices),
         "steps_per_sec": steps / elapsed,
@@ -96,3 +97,13 @@ def measure_throughput(
         "n_devices": float(len(devices)),
         "final_loss": float(metrics["loss"]),
     }
+    if flops_per_step:
+        # Per-device program FLOPs (post-partitioning): chip-level MFU.
+        result["model_flops_per_step_per_chip"] = flops_per_step
+        mfu = flops_lib.mfu(
+            flops_per_step, result["steps_per_sec"],
+            flops_lib.peak_flops_per_chip(devices[0]),
+        )
+        if mfu is not None:
+            result["mfu"] = mfu
+    return result

@@ -38,6 +38,7 @@ from tf_yarn_tpu import event
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
+from tf_yarn_tpu.utils import flops as flops_lib
 from tf_yarn_tpu.utils import mlflow
 
 _logger = logging.getLogger(__name__)
@@ -181,15 +182,30 @@ def build_eval_step(model, loss_fn):
 
 
 class _StepsPerSecondHook:
-    """Chief-only steps/sec reporting (reference StepPerSecondHook,
-    tensorflow/metrics.py:18-38): KV broadcast + MLflow + log."""
+    """Chief-only throughput reporting (reference StepPerSecondHook,
+    tensorflow/metrics.py:18-38): KV broadcast + MLflow + log.
 
-    def __init__(self, runtime, every: int, n_try: int = 0) -> None:
+    Beyond the reference's steps/sec, every report carries samples/sec,
+    tokens/sec (sequence batches) and **MFU** when the XLA cost analysis
+    and chip peak are known — so every run, not just bench.py, records
+    how much of the hardware it used."""
+
+    def __init__(self, runtime, every: int, n_try: int = 0,
+                 resume_step: int = 0, flops_per_step: Optional[float] = None,
+                 samples_per_step: Optional[int] = None,
+                 tokens_per_step: Optional[int] = None,
+                 peak_flops: Optional[float] = None) -> None:
         self._runtime = runtime
         self._every = max(1, every)
         self._n_try = n_try
         self._t0 = time.time()
-        self._step0 = 0
+        # Start counting at the resume step, or the first report after a
+        # checkpoint restore would be inflated by resume_step/elapsed.
+        self._step0 = resume_step
+        self._flops_per_step = flops_per_step
+        self._samples_per_step = samples_per_step
+        self._tokens_per_step = tokens_per_step
+        self._peak_flops = peak_flops
 
     def after_step(self, step: int, metrics: Dict[str, Any], force: bool = False) -> None:
         if step % self._every != 0 and not force:
@@ -198,14 +214,29 @@ class _StepsPerSecondHook:
         steps_per_sec = (step - self._step0) / max(now - self._t0, 1e-9)
         self._t0, self._step0 = now, step
         loss = metrics.get("loss")
-        _logger.info("step %d: loss=%s steps/sec=%.3f", step, loss, steps_per_sec)
-        mlflow.log_metric(f"steps_per_sec_{self._n_try}", steps_per_sec, step=step)
+        report = {"steps_per_sec": steps_per_sec}
+        if self._samples_per_step:
+            report["samples_per_sec"] = steps_per_sec * self._samples_per_step
+        if self._tokens_per_step:
+            report["tokens_per_sec"] = steps_per_sec * self._tokens_per_step
+        mfu_value = flops_lib.mfu(
+            self._flops_per_step, steps_per_sec, self._peak_flops
+        )
+        if mfu_value is not None:
+            report["mfu"] = mfu_value
+        _logger.info(
+            "step %d: loss=%s %s", step, loss,
+            " ".join(f"{k}={v:.3f}" for k, v in report.items()),
+        )
+        for key, value in report.items():
+            mlflow.log_metric(f"{key}_{self._n_try}", value, step=step)
         if self._runtime is not None:
-            event.broadcast(
-                self._runtime.kv,
-                f"{self._runtime.task}/steps_per_sec",
-                f"{steps_per_sec:.3f}",
-            )
+            for key, value in report.items():
+                event.broadcast(
+                    self._runtime.kv,
+                    f"{self._runtime.task}/{key}",
+                    f"{value:.6g}",
+                )
             event.broadcast(
                 self._runtime.kv, f"{self._runtime.task}/last_training_step", str(step)
             )
@@ -292,7 +323,7 @@ def train_and_evaluate(
             ckpt_writer = ckpt_lib.CheckpointWriter(params_cfg.keep_last_n)
             _cleanup.callback(ckpt_writer.close)
 
-        train_step = jax.jit(
+        train_step_jit = jax.jit(
             build_train_step(
                 core.model, core.loss_fn, core.optimizer,
                 grad_accum_steps=params_cfg.grad_accum_steps,
@@ -300,11 +331,23 @@ def train_and_evaluate(
             donate_argnums=(0,),
             out_shardings=(state_shardings, None),
         )
+        # AOT-compile: the loop calls the compiled executable directly and
+        # its XLA cost analysis prices one step for the MFU report.
+        train_step = train_step_jit.lower(
+            state, first_global, train_rng
+        ).compile()
+        flops_per_step = flops_lib.compiled_flops(train_step)
         eval_step = jax.jit(build_eval_step(core.model, core.loss_fn))
 
+        samples_per_step, tokens_per_step = flops_lib.batch_counts(first_global)
         hook = _StepsPerSecondHook(
             runtime, params_cfg.log_every_steps,
             n_try=runtime.n_try if runtime is not None else 0,
+            resume_step=resume_step,
+            flops_per_step=flops_per_step,
+            samples_per_step=samples_per_step,
+            tokens_per_step=tokens_per_step,
+            peak_flops=flops_lib.peak_flops_per_chip(mesh.devices.flat[0]),
         )
         tb_writer = _make_tb_writer(core.model_dir)
 
@@ -321,10 +364,25 @@ def train_and_evaluate(
 
         batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
         batch = first_global
+        expected_shapes = jax.tree_util.tree_map(lambda a: a.shape, first_global)
+        warned_ragged = False
         step = resume_step
         try:
             while step < params_cfg.train_steps:
-                state, metrics = train_step(state, batch, train_rng)
+                if jax.tree_util.tree_map(
+                    lambda a: a.shape, batch
+                ) == expected_shapes:
+                    state, metrics = train_step(state, batch, train_rng)
+                else:
+                    # Ragged batch (e.g. epoch tail): the AOT executable is
+                    # shape-locked, fall back to the retracing jit path.
+                    if not warned_ragged:
+                        warned_ragged = True
+                        _logger.warning(
+                            "batch shapes changed mid-run; recompiling. Use "
+                            "fixed-size batches (drop the epoch tail) on TPU."
+                        )
+                    state, metrics = train_step_jit(state, batch, train_rng)
                 step += 1
                 if (
                     step % params_cfg.log_every_steps == 0

@@ -50,6 +50,34 @@ def test_batch_counts():
     assert flops_lib.batch_counts(feat_batch) == (16, None)
 
 
+def test_hook_ragged_interval_scales_work(monkeypatch):
+    from tf_yarn_tpu import training
+
+    logged = {}
+    monkeypatch.setattr(
+        training.mlflow, "log_metric",
+        lambda key, value, step=None: logged.setdefault(key, value),
+    )
+    hook = training._StepsPerSecondHook(
+        None, every=2, samples_per_step=8, tokens_per_step=256,
+        flops_per_step=1e9, peak_flops=1e12,
+    )
+    time.sleep(0.02)
+    hook.record_batch(8)
+    hook.record_batch(4)  # ragged epoch tail
+    hook.after_step(2, {"loss": 1.0})
+    # 12 of 16 assumed samples ran: every throughput number scales by 3/4.
+    assert logged["samples_per_sec_0"] == pytest.approx(
+        logged["steps_per_sec_0"] * 8 * 0.75
+    )
+    assert logged["tokens_per_sec_0"] == pytest.approx(
+        logged["steps_per_sec_0"] * 256 * 0.75
+    )
+    assert logged["mfu_0"] == pytest.approx(
+        1e9 * logged["steps_per_sec_0"] * 0.75 / 1e12
+    )
+
+
 def test_train_loop_survives_ragged_tail_batch():
     from tf_yarn_tpu.experiment import as_core_experiment
     from tf_yarn_tpu.models import transformer
@@ -98,6 +126,7 @@ def test_hook_resume_not_inflated(monkeypatch):
         peak_flops=1e12,
     )
     time.sleep(0.05)
+    hook.record_batch(8)
     hook.after_step(1001, {"loss": 1.0})
     # One step over ~0.05s: far below the ~20000/s a zero-based _step0
     # would report after resume.

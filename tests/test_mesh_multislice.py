@@ -1,0 +1,129 @@
+"""Multi-slice DCN placement: slice-grouped device ordering, its error
+paths, and a real 2-virtual-slice training step (VERDICT r1 item 6)."""
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu.parallel import mesh as mesh_lib
+from tf_yarn_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    order_devices_for_slices,
+    select_devices,
+)
+
+
+class _StubDevice:
+    def __init__(self, dev_id, slice_index):
+        self.id = dev_id
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+def _stub_pod(n_slices, per_slice, interleave=False):
+    """Fabricated multi-slice pod. interleave=True returns devices in an
+    order where slices alternate (the hostile input for grouping)."""
+    devices = [
+        _StubDevice(s * per_slice + i, s)
+        for s in range(n_slices)
+        for i in range(per_slice)
+    ]
+    if interleave:
+        devices = [
+            devices[s * per_slice + i]
+            for i in range(per_slice)
+            for s in range(n_slices)
+        ]
+    return devices
+
+
+def test_single_slice_order_unchanged():
+    devices = _stub_pod(1, 8)
+    spec = MeshSpec(fsdp=8)
+    assert order_devices_for_slices(spec, devices, [0] * 8) == devices
+
+
+def test_two_slices_grouped_on_dp_axis():
+    devices = _stub_pod(2, 4, interleave=True)
+    spec = MeshSpec(dp=2, fsdp=4)
+    ordered = order_devices_for_slices(
+        spec, devices, [d.slice_index for d in devices]
+    )
+    # Outer dp blocks must each live entirely within one slice: the first
+    # four devices (dp=0) on slice 0, the rest (dp=1) on slice 1.
+    assert [d.slice_index for d in ordered] == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_pp_outer_axis_absorbs_slices():
+    devices = _stub_pod(2, 4, interleave=True)
+    spec = MeshSpec(pp=2, tp=4)
+    ordered = order_devices_for_slices(
+        spec, devices, [d.slice_index for d in devices]
+    )
+    # pp stage 0 = slice 0, stage 1 = slice 1: tp collectives stay on ICI.
+    assert [d.slice_index for d in ordered] == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_indivisible_outer_axes_rejected():
+    devices = _stub_pod(2, 4)
+    spec = MeshSpec(fsdp=8)  # pp*dp == 1, not divisible by 2 slices
+    with pytest.raises(ValueError, match="pp\\*dp"):
+        order_devices_for_slices(spec, devices, [d.slice_index for d in devices])
+
+
+def test_unequal_slice_sizes_rejected():
+    devices = _stub_pod(2, 4)
+    spec = MeshSpec(dp=2, fsdp=4)
+    slice_ids = [0, 0, 0, 0, 0, 1, 1, 1]  # 5 + 3
+    with pytest.raises(ValueError, match="unequal"):
+        order_devices_for_slices(spec, devices, slice_ids)
+
+
+def test_build_mesh_with_virtual_slice_ids():
+    devices = select_devices(8, platform="cpu")
+    # Interleaved slice assignment: device i on slice i%2.
+    slice_ids = [i % 2 for i in range(8)]
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=4), devices, slice_ids=slice_ids)
+    by_id = dict(zip((d.id for d in devices), slice_ids))
+    mesh_grid = mesh.devices.reshape(2, 4)  # (dp, fsdp)
+    for dp_idx in range(2):
+        slices_in_block = {by_id[d.id] for d in mesh_grid[dp_idx]}
+        assert len(slices_in_block) == 1, (
+            f"dp block {dp_idx} spans slices {slices_in_block}"
+        )
+
+
+def test_build_mesh_slice_ids_length_mismatch():
+    devices = select_devices(4, platform="cpu")
+    with pytest.raises(ValueError, match="slice_ids"):
+        build_mesh(MeshSpec(fsdp=4), devices, slice_ids=[0, 1])
+
+
+def test_training_step_over_two_virtual_slices():
+    """Full sharded train step on a mesh whose dp axis straddles two
+    fabricated slices — the dryrun the driver repeats via
+    __graft_entry__.dryrun_multichip."""
+    from tf_yarn_tpu.experiment import as_core_experiment
+    from tf_yarn_tpu.models import transformer
+    from tf_yarn_tpu.training import train_and_evaluate
+
+    devices = select_devices(8, platform="cpu")
+    slice_ids = [i % 2 for i in range(8)]
+    spec = MeshSpec(dp=2, fsdp=4)
+    mesh = build_mesh(spec, devices, slice_ids=slice_ids)
+    mesh_lib.set_current_mesh(mesh)
+    try:
+        cfg = transformer.TransformerConfig.tiny()
+        exp = transformer.make_experiment(
+            cfg, train_steps=2, batch_size=8, seq_len=32, mesh_spec=spec,
+        )
+        core = as_core_experiment(exp)
+        # train_and_evaluate builds its own mesh from spec+devices; feed it
+        # the slice-ordered devices so placement matches the virtual pod.
+        ordered = order_devices_for_slices(spec, devices, slice_ids)
+        metrics = train_and_evaluate(core, devices=ordered)
+        assert np.isfinite(metrics["loss"])
+    finally:
+        mesh_lib.set_current_mesh(None)

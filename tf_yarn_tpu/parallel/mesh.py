@@ -153,18 +153,56 @@ def _slice_ids(devices) -> List[int]:
     return [getattr(d, "slice_index", 0) for d in devices]
 
 
-def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+def order_devices_for_slices(
+    spec: MeshSpec, devices: Sequence, slice_ids: Sequence[int]
+) -> list:
+    """Reorder `devices` so slice boundaries align with the outer mesh
+    axes (pure logic; unit-testable with stub devices).
+
+    The outer axes (pp, then dp) must absorb the slice boundaries so only
+    their infrequent collectives cross DCN, while fsdp/sp/tp/ep stay
+    inside a slice on ICI (the scaling-book recipe; SURVEY.md §5 "data
+    plane ... DCN collectives across slices"). Requires the leading pp*dp
+    product to be divisible by the slice count.
+    """
+    if len(slice_ids) != len(devices):
+        raise ValueError(
+            f"slice_ids ({len(slice_ids)}) must match devices ({len(devices)})"
+        )
+    n_slices = len(set(slice_ids))
+    if n_slices <= 1:
+        return list(devices)
+    outer = spec.pp * spec.dp
+    if outer % n_slices:
+        raise ValueError(
+            f"multi-slice mesh needs pp*dp ({spec.pp}*{spec.dp}) "
+            f"divisible by the slice count {n_slices} so cross-DCN "
+            "traffic stays on the outer axes"
+        )
+    per_slice = len(devices) // n_slices
+    grouped: Dict[int, list] = {}
+    for device, sid in zip(devices, slice_ids):
+        grouped.setdefault(sid, []).append(device)
+    if any(len(group) != per_slice for group in grouped.values()):
+        raise ValueError("slices contribute unequal device counts")
+    return [d for sid in sorted(grouped) for d in grouped[sid]]
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence] = None,
+    *,
+    slice_ids: Optional[Sequence[int]] = None,
+):
     """Build the named Mesh for `spec`.
 
     Single-slice (the common case): row-major assignment — the fastest-
     varying axes (tp/ep) land on directly-wired ICI neighbors.
 
-    Multi-slice pods (devices carrying distinct `slice_index`): the outer
-    axes (pp, then dp) must align with slice boundaries so only their
-    infrequent collectives cross DCN, while fsdp/sp/tp/ep stay inside a
-    slice on ICI (the scaling-book recipe; SURVEY.md §5 "data plane ...
-    DCN collectives across slices"). Requires the leading pp*dp product to
-    be divisible by the slice count.
+    Multi-slice pods: devices carrying distinct `slice_index` are grouped
+    so slice boundaries align with the outer (pp, dp) axes — see
+    `order_devices_for_slices`. `slice_ids` overrides the per-device
+    attribute (virtual-slice testing on platforms without one).
     """
     from jax.sharding import Mesh
 
@@ -175,23 +213,9 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
             f"MeshSpec wants {spec.total_devices} devices "
             f"({dict(zip(spec.axis_names, spec.axis_sizes))}), got {len(devices)}"
         )
-    slice_ids = _slice_ids(devices)
-    n_slices = len(set(slice_ids))
-    if n_slices > 1:
-        outer = spec.pp * spec.dp
-        if outer % n_slices:
-            raise ValueError(
-                f"multi-slice mesh needs pp*dp ({spec.pp}*{spec.dp}) "
-                f"divisible by the slice count {n_slices} so cross-DCN "
-                "traffic stays on the outer axes"
-            )
-        per_slice = len(devices) // n_slices
-        grouped: Dict[int, list] = {}
-        for device, sid in zip(devices, slice_ids):
-            grouped.setdefault(sid, []).append(device)
-        if any(len(group) != per_slice for group in grouped.values()):
-            raise ValueError("slices contribute unequal device counts")
-        devices = [d for sid in sorted(grouped) for d in grouped[sid]]
+    if slice_ids is None:
+        slice_ids = _slice_ids(devices)
+    devices = order_devices_for_slices(spec, devices, slice_ids)
     mesh_devices = np.asarray(devices).reshape(spec.axis_sizes)
     return Mesh(mesh_devices, spec.axis_names)
 

@@ -206,21 +206,45 @@ class _StepsPerSecondHook:
         self._samples_per_step = samples_per_step
         self._tokens_per_step = tokens_per_step
         self._peak_flops = peak_flops
+        self._interval_samples = 0
+
+    def record_batch(self, n_samples: Optional[int]) -> None:
+        """Count the actual batch size of a step, so intervals containing
+        ragged (epoch-tail) batches report true samples/tokens/MFU rather
+        than full-batch assumptions."""
+        self._interval_samples += (
+            n_samples if n_samples is not None else (self._samples_per_step or 0)
+        )
 
     def after_step(self, step: int, metrics: Dict[str, Any], force: bool = False) -> None:
         if step % self._every != 0 and not force:
             return
         now = time.time()
-        steps_per_sec = (step - self._step0) / max(now - self._t0, 1e-9)
+        elapsed = max(now - self._t0, 1e-9)
+        n_steps = step - self._step0
+        steps_per_sec = n_steps / elapsed
+        # Fraction of assumed-full work actually done this interval
+        # (tokens and batch-dim FLOPs both scale with the sample count).
+        full = (self._samples_per_step or 0) * n_steps
+        work_frac = (
+            self._interval_samples / full
+            if full and self._interval_samples
+            else 1.0
+        )
         self._t0, self._step0 = now, step
+        self._interval_samples = 0
         loss = metrics.get("loss")
         report = {"steps_per_sec": steps_per_sec}
         if self._samples_per_step:
-            report["samples_per_sec"] = steps_per_sec * self._samples_per_step
+            report["samples_per_sec"] = (
+                steps_per_sec * self._samples_per_step * work_frac
+            )
         if self._tokens_per_step:
-            report["tokens_per_sec"] = steps_per_sec * self._tokens_per_step
+            report["tokens_per_sec"] = (
+                steps_per_sec * self._tokens_per_step * work_frac
+            )
         mfu_value = flops_lib.mfu(
-            self._flops_per_step, steps_per_sec, self._peak_flops
+            self._flops_per_step, steps_per_sec * work_frac, self._peak_flops
         )
         if mfu_value is not None:
             report["mfu"] = mfu_value
@@ -364,14 +388,18 @@ def train_and_evaluate(
 
         batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
         batch = first_global
-        expected_shapes = jax.tree_util.tree_map(lambda a: a.shape, first_global)
+        expected_shapes = tuple(
+            a.shape for a in jax.tree_util.tree_leaves(first_global)
+        )
         warned_ragged = False
         step = resume_step
         try:
             while step < params_cfg.train_steps:
-                if jax.tree_util.tree_map(
-                    lambda a: a.shape, batch
-                ) == expected_shapes:
+                shapes = tuple(
+                    a.shape for a in jax.tree_util.tree_leaves(batch)
+                )
+                hook.record_batch(shapes[0][0] if shapes else None)
+                if shapes == expected_shapes:
                     state, metrics = train_step(state, batch, train_rng)
                 else:
                     # Ragged batch (e.g. epoch tail): the AOT executable is

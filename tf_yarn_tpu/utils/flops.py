@@ -40,7 +40,13 @@ def peak_flops_per_chip(device) -> Optional[float]:
     Override with TPU_YARN_PEAK_FLOPS_PER_CHIP (e.g. for new chips)."""
     override = os.environ.get(ENV_PEAK_FLOPS)
     if override:
-        return float(override)
+        try:
+            return float(override)
+        except ValueError:
+            _logger.warning(
+                "ignoring malformed %s=%r (want a number, e.g. 1.97e14)",
+                ENV_PEAK_FLOPS, override,
+            )
     kind = getattr(device, "device_kind", "").lower()
     if "tpu" not in kind:
         return None

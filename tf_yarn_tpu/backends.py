@@ -82,6 +82,11 @@ class ClusterHandle(ABC):
 
 
 class SliceBackend(ABC):
+    # Whether tasks run on other machines (drives the coordinator
+    # advertise-address choice in client.run_on_tpu). Custom backends
+    # should override when they launch locally.
+    is_remote = True
+
     @abstractmethod
     def launch(
         self, services: Dict[str, ServiceSpec], log_dir: str
@@ -157,6 +162,8 @@ class LocalBackend(SliceBackend):
     env vars — the same contract `_env.gen_task_module` defines for every
     backend (reference container command: _env.py:10-24).
     """
+
+    is_remote = False
 
     def __init__(self, python: Optional[str] = None) -> None:
         self._python = python or sys.executable
@@ -234,29 +241,98 @@ class SshBackend(SliceBackend):
     """Place one task runner per TPU-VM worker over ssh.
 
     The multi-host analog of YARN container launch: host *i* of the slice
-    runs the *i*-th task instance (chief = worker 0, SURVEY.md §7.2). The
-    remote side needs this package importable (env packaging — the
-    reference ships a pex through HDFS, packaging.py; here a shared
-    filesystem / pre-provisioned image fills that role, with `remote_prefix`
-    pointing at the code root).
+    runs the *i*-th task instance (chief = worker 0, SURVEY.md §7.2).
+
+    * ``hosts=None`` autodiscovers the slice's workers
+      (tf_yarn_tpu.discovery: env override → GCE metadata → gcloud).
+    * ``files=`` on a ServiceSpec are shipped per task: tarred locally,
+      streamed over the ssh channel into a per-run remote workdir, and the
+      task starts with cwd there and the workdir on PYTHONPATH — container
+      upload semantics (reference: client.py:337-344) without needing a
+      shared filesystem. `remote_prefix` (a pre-provisioned code root)
+      additionally lands on PYTHONPATH.
+    * ``ssh_cmd`` swaps the transport binary — integration tests drive the
+      full path through a local shell shim, no sshd required.
     """
+
+    is_remote = True
 
     def __init__(
         self,
-        hosts: List[TpuVmHost],
+        hosts: Optional[List[TpuVmHost]] = None,
         python: str = "python3",
         remote_prefix: str = "",
         ssh_options: Optional[List[str]] = None,
+        ssh_cmd: Optional[List[str]] = None,
+        tpu_name: Optional[str] = None,
+        zone: Optional[str] = None,
     ) -> None:
         self._hosts = hosts
         self._python = python
         self._remote_prefix = remote_prefix
-        self._ssh_options = ssh_options or ["-o", "StrictHostKeyChecking=no"]
+        self._ssh_cmd = list(ssh_cmd) if ssh_cmd else [
+            "ssh", *(ssh_options or ["-o", "StrictHostKeyChecking=no"])
+        ]
+        self._tpu_name = tpu_name
+        self._zone = zone
+
+    def _resolve_hosts(self) -> List[TpuVmHost]:
+        if self._hosts is not None:
+            return self._hosts
+        from tf_yarn_tpu.discovery import discover_tpu_vm_hosts
+
+        self._hosts = discover_tpu_vm_hosts(self._tpu_name, self._zone)
+        return self._hosts
+
+    @staticmethod
+    def _pack_files(files: Dict[str, str]) -> str:
+        """Tar `name -> local path` entries into a temp archive."""
+        import tarfile
+        import tempfile
+
+        fd, tar_path = tempfile.mkstemp(suffix=".tar.gz", prefix="tpu_yarn_files-")
+        os.close(fd)
+        with tarfile.open(tar_path, "w:gz") as tar:
+            for name, src in files.items():
+                tar.add(src, arcname=name)
+        return tar_path
+
+    def _ship_files(self, hostname: str, tar_path: str, remote_dir: str) -> None:
+        """Stream the tar through the ssh channel into remote_dir."""
+        unpack = f"mkdir -p {remote_dir} && tar xzf - -C {remote_dir}"
+        with open(tar_path, "rb") as tar_file:
+            result = subprocess.run(
+                [*self._ssh_cmd, hostname, unpack],
+                stdin=tar_file,
+                capture_output=True,
+            )
+        if result.returncode != 0:
+            raise RuntimeError(
+                f"shipping files to {hostname} failed: "
+                f"{result.stderr.decode(errors='replace').strip()}"
+            )
+
+    @staticmethod
+    def _dq_escape(value: str) -> str:
+        """Escape for interpolation inside a double-quoted shell string
+        (so `$PWD`-style parts we add on purpose still expand)."""
+        for ch in ("\\", '"', "$", "`"):
+            value = value.replace(ch, "\\" + ch)
+        return value
 
     def launch(
         self, services: Dict[str, ServiceSpec], log_dir: str
     ) -> _LocalHandle:
+        import re
+        from concurrent.futures import ThreadPoolExecutor
+
         os.makedirs(log_dir, exist_ok=True)
+        hosts = self._resolve_hosts()
+        # The run id lands in remote shell commands: keep it shell-inert.
+        run_id = re.sub(
+            r"[^A-Za-z0-9._-]", "_",
+            os.path.basename(os.path.normpath(log_dir)),
+        )
         assignments: List[Tuple[TaskKey, ServiceSpec]] = []
         for task_type in ("chief", "worker", "evaluator", "tensorboard"):
             spec = services.get(task_type)
@@ -264,35 +340,90 @@ class SshBackend(SliceBackend):
                 continue
             for task_id in range(spec.instances):
                 assignments.append((TaskKey(task_type, task_id), spec))
-        if len(assignments) > len(self._hosts):
+        if len(assignments) > len(hosts):
             raise ValueError(
-                f"{len(assignments)} task instances > {len(self._hosts)} TPU VM hosts"
+                f"{len(assignments)} task instances > {len(hosts)} TPU VM hosts"
             )
+        tar_cache: Dict[int, str] = {}
         procs: Dict[TaskKey, subprocess.Popen] = {}
         log_files: Dict[TaskKey, str] = {}
-        for host, (key, spec) in zip(self._hosts, assignments):
-            if spec.files:
-                raise NotImplementedError(
-                    "files= shipping over SshBackend is not implemented yet; "
-                    "stage files on a shared filesystem (see packaging.upload_env "
-                    "+ pre_script_hook) instead"
+        try:
+            # Ship files to every host first, concurrently — launch time
+            # stays bounded by the slowest transfer, not the host count.
+            remote_dirs: Dict[TaskKey, str] = {}
+            ship_jobs = []
+            for host, (key, spec) in zip(hosts, assignments):
+                if not spec.files:
+                    continue
+                if id(spec) not in tar_cache:
+                    tar_cache[id(spec)] = self._pack_files(spec.files)
+                remote_dirs[key] = (
+                    f"$HOME/.tpu_yarn_runs/{run_id}/{key.type}-{key.id}"
                 )
-            env_exports = " ".join(
-                f"{k}={shlex.quote(v)}"
-                for k, v in {**spec.env, constants.ENV_TASK_KEY: key.to_kv_str()}.items()
-            )
-            prefix = f"cd {shlex.quote(self._remote_prefix)} && " if self._remote_prefix else ""
-            hook = f"{spec.pre_script_hook}; " if spec.pre_script_hook else ""
-            remote_cmd = (
-                f"{prefix}{hook}env {env_exports} {self._python} -m {spec.module}"
-            )
-            log_path = os.path.join(log_dir, f"{key.type}-{key.id}.log")
-            log_files[key] = log_path
-            with open(log_path, "wb") as log_file:
-                procs[key] = subprocess.Popen(
-                    ["ssh", *self._ssh_options, host.hostname, remote_cmd],
-                    stdout=log_file,
-                    stderr=subprocess.STDOUT,
+                ship_jobs.append(
+                    (host.hostname, tar_cache[id(spec)], remote_dirs[key])
                 )
-            _logger.info("launched %s on %s", key, host.hostname)
+            if ship_jobs:
+                with ThreadPoolExecutor(max_workers=min(16, len(ship_jobs))) as pool:
+                    for future in [
+                        pool.submit(self._ship_files, *job) for job in ship_jobs
+                    ]:
+                        future.result()
+
+            for host, (key, spec) in zip(hosts, assignments):
+                workdir_prefix = ""
+                pythonpath_parts = []
+                if self._remote_prefix:
+                    pythonpath_parts.append(self._dq_escape(self._remote_prefix))
+                if spec.files:
+                    workdir_prefix = f"cd {remote_dirs[key]} && "
+                    pythonpath_parts.append("$PWD")
+                elif self._remote_prefix:
+                    workdir_prefix = (
+                        f"cd {shlex.quote(self._remote_prefix)} && "
+                    )
+                task_env = {
+                    **spec.env, constants.ENV_TASK_KEY: key.to_kv_str()
+                }
+                # PYTHONPATH merges (matching LocalBackend) instead of the
+                # last `env` assignment silently winning.
+                caller_pythonpath = task_env.pop("PYTHONPATH", "")
+                if caller_pythonpath:
+                    pythonpath_parts.append(self._dq_escape(caller_pythonpath))
+                env_exports = " ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in task_env.items()
+                )
+                if pythonpath_parts:
+                    # Deliberately double-quoted: $PWD/$PYTHONPATH expand in
+                    # the remote shell; literal parts are escaped above.
+                    env_exports += (
+                        f' PYTHONPATH="{":".join(pythonpath_parts)}:$PYTHONPATH"'
+                    )
+                hook = f"{spec.pre_script_hook}; " if spec.pre_script_hook else ""
+                remote_cmd = (
+                    f"{workdir_prefix}{hook}env {env_exports} "
+                    f"{self._python} -m {spec.module}"
+                )
+                log_path = os.path.join(log_dir, f"{key.type}-{key.id}.log")
+                log_files[key] = log_path
+                with open(log_path, "wb") as log_file:
+                    procs[key] = subprocess.Popen(
+                        [*self._ssh_cmd, host.hostname, remote_cmd],
+                        stdout=log_file,
+                        stderr=subprocess.STDOUT,
+                    )
+                _logger.info("launched %s on %s", key, host.hostname)
+        except Exception:
+            # Don't leak half a cluster: reap anything already started.
+            for key, proc in procs.items():
+                if proc.poll() is None:
+                    _logger.warning("killing partially-launched %s", key)
+                    proc.terminate()
+            raise
+        finally:
+            for tar_path in tar_cache.values():
+                try:
+                    os.unlink(tar_path)
+                except OSError:
+                    pass
         return _LocalHandle(procs, log_files)

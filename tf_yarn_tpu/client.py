@@ -33,6 +33,7 @@ from tf_yarn_tpu._internal import MonitoredThread
 from tf_yarn_tpu.backends import (
     FAILED,
     KILLED,
+    PRIMARY_TASK_TYPES,
     RUNNING,
     ClusterHandle,
     LocalBackend,
@@ -166,6 +167,42 @@ def _start_event_listener(cluster: SliceCluster) -> MonitoredThread:
     return thread
 
 
+def _routable_host() -> str:
+    """This machine's address as other hosts see it. The UDP connect trick
+    picks the interface with a default route (no packet is sent)."""
+    import socket
+
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.connect(("8.8.8.8", 80))
+            return sock.getsockname()[0]
+    except OSError:
+        return socket.getfqdn()
+
+
+def _advertised_endpoint(
+    server_endpoint: str, backend: SliceBackend, coordinator_advertise: Optional[str]
+) -> str:
+    """The coordinator address tasks dial. Remote backends must not be
+    handed the bind host when it's loopback/wildcard — they would connect
+    to *their own* localhost and hang (ADVICE r1: client.py:350)."""
+    host, _, port = server_endpoint.rpartition(":")
+    if coordinator_advertise:
+        if ":" in coordinator_advertise:
+            return coordinator_advertise
+        return f"{coordinator_advertise}:{port}"
+    if getattr(backend, "is_remote", True) and host in (
+        "127.0.0.1", "localhost", "0.0.0.0", "",
+    ):
+        routable = _routable_host()
+        _logger.info(
+            "advertising coordinator as %s:%s to remote tasks "
+            "(bind address %s is not routable)", routable, port, host,
+        )
+        return f"{routable}:{port}"
+    return server_endpoint
+
+
 def _setup_cluster(
     task_specs: TaskSpecs,
     backend: SliceBackend,
@@ -176,6 +213,7 @@ def _setup_cluster(
     name: str,
     coordinator_bind: str,
     files: Optional[Dict[str, str]] = None,
+    coordinator_advertise: Optional[str] = None,
 ) -> SliceCluster:
     log_dir = tempfile.mkdtemp(prefix=f"{name}-logs-")
     server = start_best_server(host=coordinator_bind)
@@ -183,7 +221,7 @@ def _setup_cluster(
         kv = KVClient(server.endpoint)
         services = _setup_task_env(
             task_specs,
-            server.endpoint,
+            _advertised_endpoint(server.endpoint, backend, coordinator_advertise),
             log_dir,
             n_try,
             env,
@@ -267,7 +305,7 @@ def _execute_and_await_termination(
     failures = {
         t: o
         for t, o in outcomes.items()
-        if o.status == "FAILED" and t.split(":", 1)[0] in ("chief", "worker")
+        if o.status == "FAILED" and t.split(":", 1)[0] in PRIMARY_TASK_TYPES
     }
     if failures:
         _print_failed_task_logs(cluster, failures)
@@ -348,6 +386,7 @@ def run_on_tpu(
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
     coordinator_bind: str = "127.0.0.1",
+    coordinator_advertise: Optional[str] = None,
     eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
 ) -> Optional[Metrics]:
     """Run `experiment_fn` on a TPU slice (reference `run_on_yarn`,
@@ -362,6 +401,10 @@ def run_on_tpu(
     task_specs = dict(task_specs) if task_specs else single_server_topology()
     check_topology(task_specs)
     backend = backend or LocalBackend()
+    if getattr(backend, "is_remote", True) and coordinator_bind == "127.0.0.1":
+        # Remote tasks must be able to dial in: listen on every interface
+        # and advertise a routable address (ADVICE r1).
+        coordinator_bind = "0.0.0.0"
     env = dict(env or {})
     serialized_fn = cloudpickle.dumps(experiment_fn)
 
@@ -379,6 +422,7 @@ def run_on_tpu(
                 name,
                 coordinator_bind,
                 files,
+                coordinator_advertise,
             )
             return _execute_and_await_termination(
                 cluster,

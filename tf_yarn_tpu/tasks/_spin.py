@@ -1,7 +1,9 @@
 """Test-support task: spin until killed (used to exercise the KILLED
-status path of backends without a real long training job)."""
+status path of backends without a real long training job).
+TPU_YARN_SPIN_SECS overrides the duration (0 = exit immediately)."""
 
+import os
 import time
 
 if __name__ == "__main__":
-    time.sleep(120)
+    time.sleep(float(os.environ.get("TPU_YARN_SPIN_SECS", "120")))

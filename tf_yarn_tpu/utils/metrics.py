@@ -11,6 +11,7 @@ import logging
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from tf_yarn_tpu import event
+from tf_yarn_tpu.backends import PRIMARY_TASK_TYPES
 from tf_yarn_tpu.coordination.kv import KVStore
 from tf_yarn_tpu.utils import mlflow
 
@@ -109,7 +110,7 @@ def handle_events(
         )
         task_type = task.split(":", 1)[0]
         if t_start is not None and t_stop is not None:
-            if task_type in ("chief", "worker"):
+            if task_type in PRIMARY_TASK_TYPES:
                 train_starts.append(t_start)
                 train_stops.append(t_stop)
             elif task_type == "evaluator":

@@ -79,3 +79,113 @@ def test_pytorch_ddp_e2e_two_workers(tmp_path):
     assert metrics.total_training_duration is not None
     state = model_ckpt.load_latest_ckpt(out_dir)
     assert state["epoch"] == 3
+
+
+def test_xla_backend_without_torch_xla_raises_clearly():
+    """The xla branch is gated, not silently broken, on rigs without
+    torch_xla (VERDICT r1 item 5)."""
+    from tf_yarn_tpu.tasks.distributed import TaskParameters
+    from tf_yarn_tpu.tasks.pytorch_worker import _train_one_rank
+
+    exp = pt.PytorchExperiment(
+        model=torch.nn.Linear(2, 1),
+        main_fn=lambda *a: None,
+        train_dataset=torch.utils.data.TensorDataset(torch.zeros(4, 2)),
+        backend="xla",
+    )
+    params = TaskParameters(
+        task_type="worker", task_id=0, rank=0, local_rank=0, world_size=1,
+        master_addr="127.0.0.1", master_port=29510, n_workers_per_executor=1,
+    )
+    try:
+        with pytest.raises(RuntimeError, match="torch_xla"):
+            _train_one_rank(exp, params)
+    finally:
+        # _train_one_rank exports identity env before the gate fires;
+        # don't leak it into later tests' worker subprocesses.
+        for key in ("MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE",
+                    "LOCAL_RANK"):
+            os.environ.pop(key, None)
+
+
+def _write_parquet(path, ids):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({
+        "id": pa.array(ids, pa.int64()),
+        "x": pa.array([float(i) * 0.5 for i in ids], pa.float32()),
+    })
+    pq.write_table(table, path, row_group_size=16)
+
+
+def test_torch_parquet_adapter_single_process(tmp_path):
+    from tf_yarn_tpu.data.parquet import ParquetDataset
+    from tf_yarn_tpu.data.torch_adapter import TorchParquetDataset
+
+    path = str(tmp_path / "data.parquet")
+    _write_parquet(path, list(range(40)))
+    ds = TorchParquetDataset(ParquetDataset(path, batch_size=8))
+    batches = list(ds)
+    assert all(b["id"].shape == (8,) for b in batches)
+    seen = torch.cat([b["id"] for b in batches]).tolist()
+    assert sorted(seen) == list(range(40))
+
+
+def test_pytorch_ddp_parquet_iterable_e2e(tmp_path):
+    """Two gloo workers consume the framework's own ParquetDataset through
+    the torch bridge: rows partition exactly once across ranks, and rank 0
+    uploads TB logs to a remote (pyarrow-fs) dir (VERDICT r1 item 5)."""
+    data_path = str(tmp_path / "train.parquet")
+    _write_parquet(data_path, list(range(64)))
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    tb_local = str(tmp_path / "tb_local")
+    tb_remote = str(tmp_path / "tb_remote")
+
+    def experiment_fn():
+        import torch as t
+
+        from tf_yarn_tpu import pytorch as ptm
+        from tf_yarn_tpu.data.parquet import ParquetDataset
+        from tf_yarn_tpu.data.torch_adapter import TorchParquetDataset
+
+        dataset = TorchParquetDataset(
+            ParquetDataset(data_path, batch_size=8, columns=["id", "x"])
+        )
+
+        def main_fn(model, loader, device, rank, tb_writer):
+            seen = []
+            for batch in loader:
+                assert batch["id"].shape == (8,)
+                seen.extend(batch["id"].tolist())
+            with open(f"{out_dir}/rank{rank}.txt", "w") as fh:
+                fh.write(",".join(map(str, seen)))
+            if tb_writer is not None:
+                tb_writer.add_scalar("rows", len(seen), 0)
+
+        return ptm.PytorchExperiment(
+            model=t.nn.Linear(2, 1),
+            main_fn=main_fn,
+            train_dataset=dataset,
+            tensorboard_log_dir=tb_local,
+            tensorboard_remote_dir=tb_remote,
+        )
+
+    pt.run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=2)},
+        poll_every_secs=0.3,
+    )
+    ranks = {}
+    for rank in (0, 1):
+        with open(f"{out_dir}/rank{rank}.txt") as fh:
+            ranks[rank] = [int(v) for v in fh.read().split(",") if v]
+    assert ranks[0] and ranks[1]
+    assert not set(ranks[0]) & set(ranks[1]), "ranks saw overlapping rows"
+    assert sorted(ranks[0] + ranks[1]) == list(range(64))
+    # TB logs were uploaded to the "remote" fs by rank 0.
+    uploaded = [
+        name for _, _, files in os.walk(tb_remote) for name in files
+    ]
+    assert uploaded, "no TB event files uploaded"

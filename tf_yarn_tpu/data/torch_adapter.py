@@ -1,0 +1,77 @@
+"""ParquetDataset → torch IterableDataset bridge.
+
+The reference's PyTorch worker consumes WebDataset iterables via
+`wds.WebLoader` (reference: pytorch/tasks/worker.py:50-65) but its own
+ParquetDataset can't feed its own worker. Here the bridge is explicit:
+`TorchParquetDataset` wraps :class:`tf_yarn_tpu.data.parquet.ParquetDataset`
+as a `torch.utils.data.IterableDataset` that re-shards by the *live*
+process-group rank (and DataLoader worker id), so one dataset object
+pickles into every DDP process and still partitions rows exactly once.
+
+Yields pre-batched `{column: torch.Tensor}` dicts — pass it through a
+DataLoader with ``batch_size=None`` (the pytorch worker does this
+automatically via the ``yields_batches`` marker).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterator
+
+import torch
+from torch.utils.data import IterableDataset
+
+from tf_yarn_tpu.data.parquet import ParquetDataset
+
+_logger = logging.getLogger(__name__)
+
+
+class TorchParquetDataset(IterableDataset):
+    """Sample-level-sharded Parquet batches as torch tensors."""
+
+    # The pytorch worker reads this to build the DataLoader with
+    # batch_size=None (batches come pre-assembled).
+    yields_batches = True
+
+    def __init__(self, dataset: ParquetDataset) -> None:
+        super().__init__()
+        self._dataset = dataset
+
+    def _effective_shard(self) -> "tuple[int, int]":
+        """(rank, world) folding DDP rank × DataLoader worker id into one
+        modulo shard, so num_workers > 0 never duplicates rows."""
+        import os
+
+        import torch.distributed as dist
+        import torch.utils.data as tud
+
+        if dist.is_available() and dist.is_initialized():
+            rank, world = dist.get_rank(), dist.get_world_size()
+        else:
+            # Spawned DataLoader workers have no process group; the
+            # pytorch worker exports RANK/WORLD_SIZE to every task process
+            # precisely so sharding survives the spawn context.
+            rank = int(os.environ.get("RANK", "0"))
+            world = int(os.environ.get("WORLD_SIZE", "1"))
+        info = tud.get_worker_info()
+        if info is not None:
+            rank = rank * info.num_workers + info.id
+            world = world * info.num_workers
+        return rank, world
+
+    def __iter__(self) -> Iterator[Dict[str, torch.Tensor]]:
+        rank, world = self._effective_shard()
+        base = self._dataset
+        sharded = ParquetDataset(
+            base.paths,
+            base.batch_size,
+            columns=base.columns,
+            rank=rank,
+            world_size=world,
+            filesystem=base.filesystem,
+            repeat=base.repeat,
+        )
+        for batch in sharded:
+            yield {
+                name: torch.from_numpy(array) for name, array in batch.items()
+            }

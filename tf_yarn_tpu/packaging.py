@@ -65,25 +65,60 @@ def zip_path(py_dir: str, include_base_name: bool = True) -> str:
     return out_path
 
 
+def _resolve_fs(target_dir: str, filesystem):
+    if filesystem is None:
+        from pyarrow import fs as pafs
+
+        filesystem, target_dir = pafs.FileSystem.from_uri(target_dir)
+    return filesystem, target_dir.rstrip("/")
+
+
+def _copy_file_to_fs(local_path: str, filesystem, remote_path: str) -> None:
+    with open(local_path, "rb") as src, filesystem.open_output_stream(
+        remote_path
+    ) as dst:
+        while True:
+            chunk = src.read(1 << 20)
+            if not chunk:
+                break
+            dst.write(chunk)
+
+
 def upload_env(
     package_path: str, target_dir: str, filesystem=None
 ) -> str:
     """Copy a packed archive to `target_dir` on any pyarrow filesystem
     (local path, gs://, hdfs:// — the upload_env_to_hdfs role,
     reference: packaging.py:39-56). Returns the remote path."""
-    name = os.path.basename(package_path)
-    if filesystem is None:
-        from pyarrow import fs as pafs
-
-        filesystem, target_dir = pafs.FileSystem.from_uri(target_dir)
+    filesystem, target_dir = _resolve_fs(target_dir, filesystem)
     filesystem.create_dir(target_dir, recursive=True)
-    remote = f"{target_dir.rstrip('/')}/{name}"
-    with open(package_path, "rb") as src, filesystem.open_output_stream(
-        remote
-    ) as dst:
-        dst.write(src.read())
+    remote = f"{target_dir}/{os.path.basename(package_path)}"
+    _copy_file_to_fs(package_path, filesystem, remote)
     _logger.info("uploaded %s -> %s", package_path, remote)
     return remote
+
+
+def upload_dir(local_dir: str, target_dir: str, filesystem=None) -> int:
+    """Recursively copy a local directory tree onto a pyarrow filesystem
+    (reference uploads TB logs this way, pytorch/tasks/worker.py:145-152).
+    Returns the number of files copied."""
+    if not os.path.isdir(local_dir):
+        raise ValueError(f"upload_dir: {local_dir!r} is not a directory")
+    filesystem, target_dir = _resolve_fs(target_dir, filesystem)
+    copied = 0
+    for root, _dirs, files in os.walk(local_dir):
+        rel_root = os.path.relpath(root, local_dir)
+        remote_root = (
+            target_dir if rel_root == "." else f"{target_dir}/{rel_root}"
+        )
+        filesystem.create_dir(remote_root, recursive=True)
+        for name in files:
+            _copy_file_to_fs(
+                os.path.join(root, name), filesystem, f"{remote_root}/{name}"
+            )
+            copied += 1
+    _logger.info("uploaded %d files %s -> %s", copied, local_dir, target_dir)
+    return copied
 
 
 def get_editable_requirements() -> Dict[str, str]:

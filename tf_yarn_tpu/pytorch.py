@@ -64,6 +64,10 @@ class PytorchExperiment:
     train_dataset: Any
     dataloader_args: DataLoaderArgs = dataclasses.field(default_factory=DataLoaderArgs)
     tensorboard_log_dir: Optional[str] = None
+    # Rank 0 uploads the TB event files here after training (any pyarrow
+    # fs URI — hdfs://, gs://, or a plain path; reference:
+    # pytorch/tasks/worker.py:145-152 `tensorboard_hdfs_dir`).
+    tensorboard_remote_dir: Optional[str] = None
     ddp_args: DistributedDataParallelArgs = dataclasses.field(
         default_factory=DistributedDataParallelArgs
     )

@@ -38,7 +38,7 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
     worker.py:94-122)."""
     import torch
     import torch.distributed as dist
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, IterableDataset
     from torch.utils.data.distributed import DistributedSampler
 
     from tf_yarn_tpu import pytorch as pt
@@ -46,11 +46,21 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
     backend = experiment.backend or pt.collective_backend()
     os.environ.setdefault("MASTER_ADDR", params.master_addr)
     os.environ.setdefault("MASTER_PORT", str(params.master_port))
+    # Rank identity via env too: torch-xla's xla:// rendezvous and user
+    # code both read these (reference exports the same trio, worker.py).
+    os.environ["RANK"] = str(params.rank)
+    os.environ["WORLD_SIZE"] = str(params.world_size)
+    os.environ["LOCAL_RANK"] = str(params.local_rank)
     if backend == "xla":
-        # Registers the "xla" backend with torch.distributed; without this
-        # import init_process_group raises "Invalid backend".
-        import torch_xla.distributed.xla_backend  # noqa: F401
-
+        try:
+            # Registers the "xla" backend with torch.distributed; without
+            # this import init_process_group raises "Invalid backend".
+            import torch_xla.distributed.xla_backend  # noqa: F401
+        except ImportError as exc:
+            raise RuntimeError(
+                "backend='xla' needs torch_xla installed on the TPU VM "
+                "(pip install torch_xla); use backend='gloo' for CPU runs"
+            ) from exc
         dist.init_process_group(
             backend="xla",
             init_method="xla://",
@@ -74,22 +84,36 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
             )
 
         args = experiment.dataloader_args
-        sampler = DistributedSampler(
-            experiment.train_dataset,
-            num_replicas=params.world_size,
-            rank=params.rank,
-            shuffle=args.shuffle,
-        )
-        loader_kwargs = dict(
-            batch_size=args.batch_size,
-            sampler=sampler,
-            num_workers=args.num_workers,
-            pin_memory=args.pin_memory,
-            drop_last=True,
-        )
+        dataset = experiment.train_dataset
+        if isinstance(dataset, IterableDataset):
+            # Iterable datasets shard themselves (reference handles the
+            # WebDataset case via WebLoader, worker.py:50-65; here any
+            # IterableDataset works, incl. data.torch_adapter's parquet
+            # bridge). Pre-batched iterables pass through unbatched.
+            loader_kwargs = dict(num_workers=args.num_workers,
+                                 pin_memory=args.pin_memory)
+            if getattr(dataset, "yields_batches", False):
+                loader_kwargs["batch_size"] = None
+            else:
+                loader_kwargs["batch_size"] = args.batch_size
+                loader_kwargs["drop_last"] = True
+        else:
+            sampler = DistributedSampler(
+                dataset,
+                num_replicas=params.world_size,
+                rank=params.rank,
+                shuffle=args.shuffle,
+            )
+            loader_kwargs = dict(
+                batch_size=args.batch_size,
+                sampler=sampler,
+                num_workers=args.num_workers,
+                pin_memory=args.pin_memory,
+                drop_last=True,
+            )
         if args.prefetch_factor is not None and args.num_workers > 0:
             loader_kwargs["prefetch_factor"] = args.prefetch_factor
-        loader = DataLoader(experiment.train_dataset, **loader_kwargs)
+        loader = DataLoader(dataset, **loader_kwargs)
 
         tb_writer = _make_tb_writer(
             experiment.tensorboard_log_dir if params.rank == 0 else None
@@ -99,9 +123,29 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
         finally:
             if tb_writer is not None:
                 tb_writer.close()
+            if (
+                params.rank == 0
+                and experiment.tensorboard_log_dir
+                and getattr(experiment, "tensorboard_remote_dir", None)
+            ):
+                _upload_tb_logs(
+                    experiment.tensorboard_log_dir,
+                    experiment.tensorboard_remote_dir,
+                )
         _ = torch  # keep import explicit
     finally:
         dist.destroy_process_group()
+
+
+def _upload_tb_logs(local_dir: str, remote_dir: str) -> None:
+    """Rank 0 copies its TB event files to a pyarrow filesystem (HDFS/GCS)
+    after training (reference: pytorch/tasks/worker.py:145-152)."""
+    try:
+        from tf_yarn_tpu.packaging import upload_dir
+
+        upload_dir(local_dir, remote_dir)
+    except Exception:
+        _logger.exception("tensorboard log upload to %s failed", remote_dir)
 
 
 def main() -> None:

@@ -1,12 +1,16 @@
 """Benchmark: flagship training-step throughput on the local accelerator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
 
 The reference publishes no numbers (BASELINE.md: "None"), so vs_baseline
 compares against the value recorded in BENCH_BASELINE.json when present
 (our own previous round), else 1.0. The full per-config suite lives in
 benchmarks/run.py.
+
+On TPU the bench also A/Bs the kernel knobs (attention_impl=xla|flash,
+fused_norms on/off), writes the table to BENCH_AB.json, and reports the
+*best* variant as the headline (the unit string names the winning impl).
 """
 
 from __future__ import annotations
@@ -14,44 +18,100 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 
 
 def _log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
-def _probe_backend_alive(timeout_secs: float = 180.0) -> bool:
-    """Check device init in a throwaway subprocess. A wedged TPU relay
-    hangs `jax.devices()` indefinitely; benching must degrade to the CPU
-    fallback line rather than hang the caller."""
+def _probe_backend_alive() -> bool:
+    """Check device init in a throwaway subprocess, retrying with backoff.
+
+    A wedged TPU relay hangs `jax.devices()` indefinitely — but it is
+    also known to *recover*, so a single failed probe must not condemn
+    the whole bench to the CPU fallback (round-1 verdict). We keep
+    probing until TPU_YARN_BENCH_PROBE_BUDGET_S (default 900s) is spent,
+    then degrade.
+    """
     import subprocess
 
     if os.environ.get("TPU_YARN_PLATFORM"):
         return True  # explicitly forced; nothing to probe
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_secs,
-            capture_output=True,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+
+    budget = float(os.environ.get("TPU_YARN_BENCH_PROBE_BUDGET_S", "900"))
+    deadline = time.time() + budget
+    attempt, backoff = 0, 30.0
+    hard_failures = 0
+    while True:
+        attempt += 1
+        per_try = max(30.0, min(180.0, deadline - time.time()))
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=per_try,
+                capture_output=True,
+            )
+            if probe.returncode == 0:
+                return True
+            # Fast non-zero exits are permanent breakage (jax/libtpu
+            # misconfig), not the recoverable wedged-relay hang the budget
+            # exists for — don't burn 15 minutes on them.
+            hard_failures += 1
+            _log(f"probe attempt {attempt}: device init failed "
+                 f"(rc={probe.returncode})")
+            if hard_failures >= 3:
+                _log("3 hard failures: backend is broken, not wedged")
+                return False
+        except subprocess.TimeoutExpired:
+            hard_failures = 0
+            _log(f"probe attempt {attempt}: device init hung {per_try:.0f}s")
+        remaining = deadline - time.time()
+        if remaining <= 1:
+            return False
+        wait = min(backoff, remaining)
+        _log(f"retrying probe in {wait:.0f}s ({remaining:.0f}s budget left)")
+        time.sleep(wait)
+        backoff = min(backoff * 2, 240.0)
+
+
+def _run_variant(config, batch_size: int, seq_len: int, steps: int,
+                 devices):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.transformer import Transformer
+    from tf_yarn_tpu.utils import flops as flops_lib
+
+    tokens = np.random.RandomState(0).randint(
+        0, config.vocab_size, (batch_size, seq_len), dtype=np.int32
+    )
+    model = Transformer(config)
+    return measure_throughput(
+        model,
+        common.lm_loss,
+        optax.adamw(1e-4),
+        {"tokens": tokens},
+        steps=steps,
+        devices=devices,
+        # Analytic (model_train_flops picks it for the transformer
+        # family): layer scans and pallas kernels defeat cost analysis.
+        flops_per_step=flops_lib.model_train_flops(
+            model, {"tokens": tokens}, n_devices=len(devices)
+        ),
+    )
 
 
 def bench_flagship_train():
     if not _probe_backend_alive():
-        _log("default backend unreachable (hung device init); forcing CPU")
+        _log("default backend unreachable (hung device init, budget spent); "
+             "forcing CPU")
         os.environ["TPU_YARN_PLATFORM"] = "cpu"
 
-    import numpy as np
-
-    from tf_yarn_tpu.benchmark import measure_throughput
-    from tf_yarn_tpu.models import common
-    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.models.transformer import TransformerConfig
     from tf_yarn_tpu.parallel.mesh import select_devices
-
-    import optax
 
     devices = select_devices()
     on_tpu = devices[0].platform == "tpu"
@@ -60,39 +120,83 @@ def bench_flagship_train():
     if on_tpu:
         # remat off: this config's activations fit one chip's HBM, so
         # recompute would only burn MXU cycles.
-        config = TransformerConfig(
+        base = dict(
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
         )
-        batch_size, seq_len, steps, warmup = 8, 1024, 20, 3
+        batch_size, seq_len, steps = 8, 1024, 20
+        # Axes: layer-scan on/off (unrolling lets XLA fuse across layer
+        # boundaries — measured ~+25% on v5e), attention xla/flash, fused
+        # pallas norms on/off.
+        variants = [
+            ("xla", dict(attention_impl="xla", fused_norms=False)),
+            ("xla+fused_norms", dict(attention_impl="xla", fused_norms=True)),
+            ("xla+fused+unroll", dict(attention_impl="xla", fused_norms=True,
+                                      scan_layers=False)),
+            ("flash+fused+unroll", dict(attention_impl="flash",
+                                        fused_norms=True, scan_layers=False)),
+        ]
     else:  # CPU smoke fallback so the bench always emits a line
-        config = TransformerConfig.tiny()
-        batch_size, seq_len, steps, warmup = 8, 64, 5, 1
+        base = None
+        batch_size, seq_len, steps = 8, 64, 5
+        variants = [("xla", None)]
 
-    model = Transformer(config)
-    tokens = np.random.RandomState(0).randint(
-        0, config.vocab_size, (batch_size, seq_len), dtype=np.int32
-    )
-    stats = measure_throughput(
-        model,
-        common.lm_loss,
-        optax.adamw(1e-4),
-        {"tokens": tokens},
-        steps=steps,
-        warmup=warmup,
-        devices=devices,
-    )
-    _log(
-        f"compile+warmup {stats['compile_plus_warmup_s']:.1f}s; "
-        f"step {stats['step_time_ms']:.1f}ms; loss={stats['final_loss']:.3f}"
-    )
-    return {
+    table = []
+    model_desc = None
+    for name, overrides in variants:
+        config = (TransformerConfig(**{**base, **overrides})
+                  if overrides is not None else TransformerConfig.tiny())
+        model_desc = f"d_model={config.d_model}, layers={config.n_layers}"
+        try:
+            stats = _run_variant(config, batch_size, seq_len, steps, devices)
+        except Exception as exc:  # a broken kernel must not kill the bench
+            _log(f"variant {name}: FAILED: {type(exc).__name__}: {exc}")
+            table.append({"variant": name, "error": f"{exc}"})
+            continue
+        row = {
+            "variant": name,
+            "samples_per_sec_per_chip": round(
+                stats["samples_per_sec_per_chip"], 3),
+            "step_time_ms": round(stats["step_time_ms"], 2),
+            "mfu": round(stats["mfu"], 4) if "mfu" in stats else None,
+            "final_loss": round(stats["final_loss"], 4),
+        }
+        table.append(row)
+        _log(f"variant {name}: {row['samples_per_sec_per_chip']} samples/s/chip, "
+             f"step {row['step_time_ms']}ms, mfu={row['mfu']}")
+
+    ok_rows = [r for r in table if "error" not in r]
+    if not ok_rows:
+        # Even a fully-failed sweep must emit the one JSON line.
+        return {
+            "metric": "flagship_train_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/sec/chip (all variants failed: "
+            + "; ".join(str(r.get("error", ""))[:80] for r in table) + ")",
+        }
+    best = max(ok_rows, key=lambda r: r["samples_per_sec_per_chip"])
+    if on_tpu:
+        ab_path = os.path.join(os.path.dirname(__file__), "BENCH_AB.json")
+        try:
+            with open(ab_path, "w") as fh:
+                json.dump({
+                    "config": {**base, "batch": batch_size, "seq": seq_len},
+                    "device": devices[0].device_kind,
+                    "rows": table,
+                }, fh, indent=1)
+            _log(f"A/B table -> {ab_path}")
+        except OSError as exc:
+            _log(f"could not write A/B table: {exc}")
+
+    result = {
         "metric": "flagship_train_samples_per_sec_per_chip",
-        "value": round(stats["samples_per_sec_per_chip"], 3),
-        "unit": f"samples/sec/chip (d_model={config.d_model}, "
-        f"layers={config.n_layers}, seq={seq_len}, bf16, "
-        f"{'tpu' if on_tpu else 'cpu-fallback'})",
+        "value": best["samples_per_sec_per_chip"],
+        "unit": f"samples/sec/chip ({model_desc}, seq={seq_len}, "
+        f"bf16, {'tpu, ' + best['variant'] if on_tpu else 'cpu-fallback'})",
     }
+    if best.get("mfu") is not None:
+        result["mfu"] = best["mfu"]
+    return result
 
 
 def main() -> None:

@@ -156,7 +156,7 @@ def test_measure_throughput_reports_flops():
     }
     stats = measure_throughput(
         model, common.binary_logistic_loss, optax.sgd(0.1), batch,
-        steps=3, warmup=1, devices=select_devices(4, platform="cpu"),
+        steps=3, devices=select_devices(4, platform="cpu"),
     )
     assert stats["model_flops_per_step_per_chip"] > 0
     # CPU rig: no peak table entry, so no MFU claim.

@@ -23,13 +23,20 @@ def measure_throughput(
     batch: Dict[str, Any],
     mesh_spec=None,
     steps: int = 20,
-    warmup: int = 3,
     init_fn=None,
     devices=None,
+    flops_per_step: Optional[float] = None,
 ) -> Dict[str, float]:
     """Time `steps` jitted train steps; returns throughput stats.
 
     batch: host numpy arrays (leading dim = global batch).
+    flops_per_step: optional *per-chip* model FLOPs for one step (e.g.
+    utils.flops.transformer_train_flops(...) / n_devices); defaults to
+    XLA's cost analysis of the compiled program.
+
+    Warmup is one full (untimed) execution of the same `steps`-long
+    program — there is no separate warmup knob since the scan makes every
+    execution identical.
     """
     import jax
     import numpy as np
@@ -69,22 +76,44 @@ def measure_throughput(
         abstract = jax.eval_shape(init_boxed, rng, placed)
         shardings = tree_shardings(mesh, abstract)
         state = jax.jit(init_state, out_shardings=shardings)(rng, placed)
+        step_core = build_train_step(model, loss_fn, optimizer)
+
+        # The measured loop runs *inside* one jitted program (lax.scan over
+        # `steps` train steps). Two reasons: (a) per-execution dispatch
+        # overhead — substantial on relayed/remote TPU backends — amortizes
+        # to noise; (b) sync is a scalar device_get of the last loss, which
+        # forces the whole chain on every backend (block_until_ready is
+        # advisory-only on some experimental platforms and would time
+        # dispatch, not compute).
+        def run_steps(state, batch, rng):
+            def body(carry, _):
+                state, rng = carry
+                rng, step_rng = jax.random.split(rng)
+                state, metrics = step_core(state, batch, step_rng)
+                return (state, rng), metrics["loss"]
+            (state, _), losses = jax.lax.scan(
+                body, (state, rng), None, length=steps
+            )
+            return state, losses[-1]
+
         t0 = time.time()
-        step_fn = jax.jit(
-            build_train_step(model, loss_fn, optimizer),
-            donate_argnums=(0,),
-            out_shardings=(shardings, None),
+        run_fn = jax.jit(
+            run_steps, donate_argnums=(0,), out_shardings=(shardings, None)
         ).lower(state, placed, rng).compile()
-        flops_per_step = flops_lib.compiled_flops(step_fn)
-        for _ in range(warmup):
-            state, metrics = step_fn(state, placed, rng)
-        jax.block_until_ready(state.params)
+        if flops_per_step is None:
+            # XLA counts the steps-scan body once, so the program's total
+            # IS one step's flops. Caveat inherited from cost analysis:
+            # models with their own inner scans (scan_layers) undercount —
+            # pass an analytic flops_per_step (utils.flops) for those.
+            flops_per_step = flops_lib.compiled_flops(run_fn)
+        # Warmup call (also verifies the donated-state round trip).
+        state, loss = run_fn(state, placed, rng)
+        float(jax.device_get(loss))
         compile_time = time.time() - t0
 
         t0 = time.time()
-        for _ in range(steps):
-            state, metrics = step_fn(state, placed, rng)
-        jax.block_until_ready(state.params)
+        state, loss = run_fn(state, placed, rng)
+        final_loss = float(jax.device_get(loss))
         elapsed = time.time() - t0
 
     samples_per_sec = steps * batch_size / elapsed
@@ -95,7 +124,7 @@ def measure_throughput(
         "step_time_ms": 1000 * elapsed / steps,
         "compile_plus_warmup_s": compile_time,
         "n_devices": float(len(devices)),
-        "final_loss": float(metrics["loss"]),
+        "final_loss": final_loss,
     }
     if flops_per_step:
         # Per-device program FLOPs (post-partitioning): chip-level MFU.

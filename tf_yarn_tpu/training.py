@@ -360,7 +360,10 @@ def train_and_evaluate(
         train_step = train_step_jit.lower(
             state, first_global, train_rng
         ).compile()
-        flops_per_step = flops_lib.compiled_flops(train_step)
+        flops_per_step = flops_lib.model_train_flops(
+            core.model, first_global, train_step,
+            n_devices=int(mesh.devices.size),
+        )
         eval_step = jax.jit(build_eval_step(core.model, core.loss_fn))
 
         samples_per_step, tokens_per_step = flops_lib.batch_counts(first_global)

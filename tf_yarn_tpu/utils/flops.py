@@ -70,6 +70,59 @@ def compiled_flops(compiled) -> Optional[float]:
         return None
 
 
+def transformer_train_flops(config, batch_size: int, seq_len: int,
+                            causal: bool = True) -> float:
+    """Analytic model FLOPs for ONE training step of models.transformer
+    (matmul flops only, fwd + 2x bwd — the PaLM-appendix accounting).
+
+    This is the MFU denominator of choice for the transformer family:
+    XLA's cost analysis counts `lax.scan`/while bodies once regardless of
+    trip count (so scan_layers models undercount n_layers-fold) and sees
+    zero FLOPs inside pallas kernels — both of which this model uses. The
+    causal quadratic term is counted at S^2/2 (the model-required minimum;
+    implementations that compute the full square burn hardware FLOPs
+    above this denominator, which is exactly what MFU should charge them
+    for).
+    """
+    d, hd = config.d_model, config.head_dim
+    attn_params = (
+        d * config.n_heads * hd          # wq
+        + 2 * d * config.n_kv_heads * hd  # wk, wv
+        + config.n_heads * hd * d        # wo
+    )
+    # SwiGLU: gate + up + down. Switch-MoE routes each token through one
+    # expert of the same shape, so per-token matmul flops match dense
+    # (router matmul d*E is negligible).
+    mlp_params = 3 * d * config.d_ff
+    dense_params = config.n_layers * (attn_params + mlp_params)
+    dense_params += d * config.vocab_size  # untied lm_head
+    tokens = batch_size * seq_len
+    fwd = 2.0 * tokens * dense_params
+    quad = 4.0 * batch_size * float(seq_len) ** 2 * d * config.n_layers
+    if causal:
+        quad /= 2.0
+    return 3.0 * (fwd + quad)
+
+
+def model_train_flops(model, batch, compiled=None,
+                      n_devices: int = 1) -> Optional[float]:
+    """Best-available per-chip model FLOPs for one train step on `batch`.
+
+    The transformer family gets the analytic count (its layer scan and
+    grad-accum scan defeat cost analysis's trip-count-blind walk, and
+    pallas kernels report zero flops); everything else falls back to the
+    compiled program's XLA cost analysis (already per-device).
+    """
+    cfg = getattr(model, "config", None)
+    if (cfg is not None and hasattr(cfg, "scan_layers")
+            and hasattr(cfg, "n_kv_heads")):
+        samples, tokens = batch_counts(batch)
+        if samples and tokens:
+            seq = tokens // samples
+            return transformer_train_flops(cfg, samples, seq) / n_devices
+    return compiled_flops(compiled) if compiled is not None else None
+
+
 _TOKEN_KEYS = ("tokens", "input_ids", "token_ids")
 
 

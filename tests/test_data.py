@@ -56,6 +56,23 @@ def test_sample_level_sharding_disjoint_and_complete(parquet_file):
             assert not set(per_rank[a]) & set(per_rank[b])
 
 
+def test_equal_batch_counts_across_ranks_uneven_rows(tmp_path):
+    # 79 rows, world 2: modulo sharding gives rank 0 40 rows and rank 1
+    # 39. Unequal per-rank batch counts would deadlock lockstep DDP
+    # allreduce, so both ranks must emit exactly (79 // 2) // 8 = 4
+    # batches.
+    path = str(tmp_path / "uneven.parquet")
+    pq.write_table(
+        pa.table({"x": np.arange(79, dtype=np.float32)}), path,
+        row_group_size=32,
+    )
+    counts = [
+        len(list(ParquetDataset(path, batch_size=8, rank=r, world_size=2)))
+        for r in range(2)
+    ]
+    assert counts == [4, 4]
+
+
 def test_repeat(parquet_file):
     ds = ParquetDataset(parquet_file, batch_size=50, repeat=True)
     it = iter(ds)

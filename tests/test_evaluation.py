@@ -38,8 +38,10 @@ def test_continuous_eval_evaluates_each_ckpt_once(tmp_path):
     assert np.isfinite(metrics["loss"])
     done = evaluation._evaluated_steps(str(tmp_path))
     assert done == {5, 10}
-    # Marker files carry the metrics payload.
-    with open(os.path.join(str(tmp_path), "eval-done-10.json")) as fh:
+    # Marker files carry the metrics payload, in their own subdirectory
+    # so checkpoint listings stay clean.
+    marker = os.path.join(str(tmp_path), evaluation.EVAL_DONE_DIR, "eval-done-10.json")
+    with open(marker) as fh:
         assert "loss" in json.load(fh)
 
 

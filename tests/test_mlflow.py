@@ -1,0 +1,93 @@
+"""MLflow shim behavior against a fake in-process `mlflow` module.
+
+The rig has no mlflow package, so these tests install a recording fake
+into sys.modules and reset the shim's detection memo — proving the
+plumbing that examples/mlflow_example.py asserts end-to-end when the
+real package is present (reference: examples/mlflow_example.py:113-119).
+"""
+
+import sys
+import types
+
+import pytest
+
+from tf_yarn_tpu.utils import mlflow as shim
+
+
+class _Run:
+    def __init__(self, run_id="fake-run-1"):
+        self.info = types.SimpleNamespace(run_id=run_id)
+
+
+@pytest.fixture
+def fake_mlflow(monkeypatch):
+    recorded = {"metrics": [], "params": [], "tags": [], "artifacts": []}
+    mod = types.ModuleType("mlflow")
+    mod.log_metric = lambda k, v, step=None: recorded["metrics"].append(
+        (k, v, step)
+    )
+    mod.log_param = lambda k, v: recorded["params"].append((k, v))
+    mod.set_tag = lambda k, v: recorded["tags"].append((k, v))
+    mod.log_artifact = lambda path: recorded["artifacts"].append(
+        open(path).read()
+    )
+    mod.active_run = lambda: _Run()
+    mod.start_run = lambda: _Run()
+    mod.get_tracking_uri = lambda: "file:///tmp/fake-mlflow"
+
+    exceptions = types.ModuleType("mlflow.exceptions")
+
+    class MlflowException(Exception):
+        pass
+
+    exceptions.MlflowException = MlflowException
+    tracking = types.ModuleType("mlflow.tracking")
+    tracking.is_tracking_uri_set = lambda: True
+    mod.exceptions = exceptions
+    mod.tracking = tracking
+
+    monkeypatch.setitem(sys.modules, "mlflow", mod)
+    monkeypatch.setitem(sys.modules, "mlflow.exceptions", exceptions)
+    monkeypatch.setitem(sys.modules, "mlflow.tracking", tracking)
+    monkeypatch.setattr(shim, "_USE_MLFLOW", None)
+    yield recorded
+    shim._USE_MLFLOW = None
+
+
+def test_detection_and_metric_logging(fake_mlflow):
+    assert shim.use_mlflow() is True
+    shim.log_metric("steps/sec:0", 12.5, step=7)
+    # Key sanitization: mlflow forbids ':' and '/'.
+    assert fake_mlflow["metrics"] == [("steps_sec_0", 12.5, 7)]
+
+
+def test_params_tags_artifacts(fake_mlflow):
+    shim.log_param("lr", 1e-3)
+    shim.set_tag("phase", "train")
+    shim.save_text_to_mlflow("hello world", "notes.txt")
+    assert fake_mlflow["params"] == [("lr", 1e-3)]
+    assert fake_mlflow["tags"] == [("phase", "train")]
+    assert fake_mlflow["artifacts"] == ["hello world"]
+
+
+def test_active_run_id(fake_mlflow):
+    assert shim.active_run_id() == "fake-run-1"
+
+
+def test_errors_are_swallowed(fake_mlflow, monkeypatch):
+    def boom(*a, **kw):
+        raise RuntimeError("tracking server down")
+
+    monkeypatch.setattr(sys.modules["mlflow"], "log_metric", boom)
+    shim.log_metric("k", 1.0)  # must not raise
+
+
+def test_disabled_without_mlflow(monkeypatch):
+    monkeypatch.setattr(shim, "_USE_MLFLOW", None)
+    monkeypatch.setitem(sys.modules, "mlflow", None)
+    try:
+        assert shim.use_mlflow() is False
+        shim.log_metric("k", 1.0)  # silent no-op
+        assert shim.active_run_id() == ""
+    finally:
+        shim._USE_MLFLOW = None

@@ -8,6 +8,7 @@ computation, and master election.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import logging.config
@@ -122,21 +123,53 @@ def is_worker(task_key: TaskKey) -> bool:
     return task_key.type in ("chief", "worker")
 
 
+# Held port reservation from choose_master(hold=True); module-level so it
+# survives the call and can be released once the real server has bound.
+_master_reservation: Optional[contextlib.ExitStack] = None
+
+
+def release_master_reservation() -> None:
+    """Close the reservation socket held by ``choose_master(hold=True)``."""
+    global _master_reservation
+    if _master_reservation is not None:
+        _master_reservation.close()
+        _master_reservation = None
+
+
 def choose_master(
     kv: KVStore,
     task_key: TaskKey,
     cluster_tasks: List[TaskInstance],
     timeout: float = 300.0,
+    hold: bool = False,
 ) -> str:
     """Elect the coordination master: the rank-0 process reserves a port and
     broadcasts ``host:port``; everyone else waits (reference:
     _task_commons.py:95-108). Used both for `jax.distributed.initialize`'s
     coordinator address and the torch process-group master.
+
+    With ``hold=False`` the reservation socket closes on return, leaving a
+    window before the real server binds in which another process could take
+    the port — the same documented compromise the reference makes. Servers
+    that bind with SO_REUSEPORT themselves (jax.distributed's gRPC
+    coordinator on Linux) should pass ``hold=True`` to keep the reservation
+    open across their bind, then ``release_master_reservation()``.
     """
     if is_chief(task_key, cluster_tasks):
-        with reserve_sock_addr() as (host, port):
+        stack = contextlib.ExitStack()
+        try:
+            host, port = stack.enter_context(reserve_sock_addr())
             addr = f"{host}:{port}"
             event.broadcast(kv, MASTER_ADDR, addr)
+        except BaseException:
+            stack.close()
+            raise
+        if hold:
+            global _master_reservation
+            release_master_reservation()
+            _master_reservation = stack
+        else:
+            stack.close()
     else:
         addr = event.wait(kv, MASTER_ADDR, timeout=timeout)
     host, _, port = addr.rpartition(":")

@@ -11,6 +11,9 @@ pytorch/parquet_dataset.py:15-72) with its two defects fixed by design
 * Static shapes for XLA: only full `batch_size` batches are emitted
   (`drop_last` semantics are mandatory on TPU — the compile-shape hazard
   the reference merely documents, pytorch/experiment.py:10-15).
+* Equal batch counts per rank in single-pass mode: every rank emits
+  exactly (num_rows // world_size) // batch_size batches, so lockstep
+  collectives (DDP allreduce) can't deadlock on an uneven tail.
 
 Works against any pyarrow-compatible filesystem (local, HDFS, GCS via
 pyarrow.fs), the cluster_pack.filesystem role in the reference.
@@ -89,6 +92,16 @@ class ParquetDataset:
                 global_idx += n
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        # Modulo sharding gives ranks row counts differing by up to
+        # world_size-1, which can mean a whole extra batch on some ranks.
+        # In single-pass mode every rank must emit the SAME number of
+        # batches or DDP's gradient allreduce deadlocks when the smaller
+        # ranks exhaust their loaders — cap at the minimum across ranks
+        # ((N // world) // batch), known from metadata alone.
+        max_batches = None
+        if self.world_size > 1 and not self.repeat:
+            max_batches = (self.num_samples() // self.world_size) // self.batch_size
+        emitted = 0
         # Buffers persist across epochs under repeat=True, so ranks whose
         # per-epoch row count is below batch_size still make progress (and
         # less of the tail is dropped overall).
@@ -105,12 +118,15 @@ class ParquetDataset:
                 buffered += n
                 rows_this_epoch += n
                 while buffered >= self.batch_size:
+                    if max_batches is not None and emitted >= max_batches:
+                        return
                     merged = {k: np.concatenate(v) for k, v in buffers.items()}
                     batch = {k: v[: self.batch_size] for k, v in merged.items()}
                     buffers = {
                         k: [v[self.batch_size:]] for k, v in merged.items()
                     }
                     buffered -= self.batch_size
+                    emitted += 1
                     yield batch
             if not self.repeat:
                 # final tail (< batch_size) dropped: static shapes for XLA

@@ -30,6 +30,14 @@ def prefetch(
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
+
+    class _Error:
+        # Private wrapper: identity-checked below, so iterators that
+        # legitimately yield tuples (even array-valued ones, where `==`
+        # would return an array) can never collide with the sentinel.
+        def __init__(self, exc: BaseException) -> None:
+            self.exc = exc
+
     stopped = threading.Event()
 
     def _put(item) -> bool:
@@ -47,7 +55,7 @@ def prefetch(
                 if not _put(place_fn(item) if place_fn else item):
                     return  # consumer gone: stop holding device batches
         except BaseException as exc:  # surface in consumer
-            _put(("__prefetch_error__", exc))
+            _put(_Error(exc))
         finally:
             _put(_END)
 
@@ -58,12 +66,8 @@ def prefetch(
             item = q.get()
             if item is _END:
                 return
-            if (
-                isinstance(item, tuple)
-                and len(item) == 2
-                and item[0] == "__prefetch_error__"
-            ):
-                raise item[1]
+            if isinstance(item, _Error):
+                raise item.exc
             yield item
     finally:
         # Consumer done (train_steps reached / exception / generator

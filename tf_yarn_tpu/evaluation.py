@@ -40,11 +40,14 @@ DEFAULT_IDLE_TIMEOUT_SECS = 20 * 60  # reference: evaluator_task.py:21-23
 DEFAULT_POLL_SECS = 10.0
 
 
-def _evaluated_steps(model_dir: str) -> Set[int]:
-    done = set()
-    if not os.path.isdir(model_dir):
+EVAL_DONE_DIR = "eval-done"  # bookkeeping lives out of checkpoint listings
+
+
+def _marker_steps(directory: str) -> Set[int]:
+    done: Set[int] = set()
+    if not os.path.isdir(directory):
         return done
-    for entry in os.listdir(model_dir):
+    for entry in os.listdir(directory):
         if entry.startswith("eval-done-") and entry.endswith(".json"):
             try:
                 done.add(int(entry[len("eval-done-"):-len(".json")]))
@@ -53,8 +56,19 @@ def _evaluated_steps(model_dir: str) -> Set[int]:
     return done
 
 
+def _evaluated_steps(model_dir: str) -> Set[int]:
+    # Markers written before the subdirectory move lived at the model_dir
+    # root; honor both so resuming against an old run doesn't re-evaluate
+    # (and re-emit metrics for) every checkpoint.
+    return _marker_steps(os.path.join(model_dir, EVAL_DONE_DIR)) | _marker_steps(
+        model_dir
+    )
+
+
 def _mark_evaluated(model_dir: str, step: int, metrics: dict) -> None:
-    path = os.path.join(model_dir, f"eval-done-{step}.json")
+    marker_dir = os.path.join(model_dir, EVAL_DONE_DIR)
+    os.makedirs(marker_dir, exist_ok=True)
+    path = os.path.join(marker_dir, f"eval-done-{step}.json")
     with open(path, "w") as fh:
         json.dump(metrics, fh)
 

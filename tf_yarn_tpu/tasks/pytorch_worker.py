@@ -90,6 +90,20 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
             # WebDataset case via WebLoader, worker.py:50-65; here any
             # IterableDataset works, incl. data.torch_adapter's parquet
             # bridge). Pre-batched iterables pass through unbatched.
+            if params.world_size > 1 and not any(
+                hasattr(dataset, attr)
+                for attr in ("rank", "world_size", "yields_batches")
+            ):
+                # No sampler can shard an iterable: a dataset that isn't
+                # rank-aware feeds every rank the FULL stream (world_size x
+                # duplicated epochs). Loud warning instead of silent bug.
+                _logger.warning(
+                    "IterableDataset %s exposes no rank/world_size "
+                    "attributes; every rank will iterate the whole "
+                    "dataset. Shard inside the dataset (e.g. "
+                    "data.torch_adapter.TorchParquetDataset) for "
+                    "distributed training.", type(dataset).__name__,
+                )
             loader_kwargs = dict(num_workers=args.num_workers,
                                  pin_memory=args.pin_memory)
             if getattr(dataset, "yields_batches", False):

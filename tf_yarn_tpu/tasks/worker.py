@@ -46,18 +46,27 @@ def _maybe_init_jax_distributed(runtime: _bootstrap.TaskRuntime) -> None:
             "drives all local chips); use tasks.distributed for "
             "multi-process-per-host jobs"
         )
-    addr = _task_commons.choose_master(runtime.kv, runtime.task_key, runtime.cluster_tasks)
+    # hold=True: jax.distributed's gRPC coordinator binds with SO_REUSEPORT
+    # on Linux, so the reservation can stay open across its bind — no
+    # window for another process to steal the elected port.
+    addr = _task_commons.choose_master(
+        runtime.kv, runtime.task_key, runtime.cluster_tasks, hold=True
+    )
     process_id = [ti.key for ti in primaries].index(runtime.task_key)
     import jax
 
     platform = os.environ.get("TPU_YARN_PLATFORM")
     if platform:  # narrow backend selection before any distributed setup
         jax.config.update("jax_platforms", platform)
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=len(primaries),
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=len(primaries),
+            process_id=process_id,
+        )
+    finally:
+        # Coordinator (or its failure) has the port now; drop the hold.
+        _task_commons.release_master_reservation()
     _logger.info(
         "jax.distributed up: process %d/%d, coordinator %s",
         process_id, len(primaries), addr,

@@ -143,10 +143,13 @@ def bench_llama_lora(tpu: bool):
 
     if tpu:
         # Largest decoder that fits one v5e chip comfortably for a bench.
+        # flash attention is what makes it fit: xla attention's saved
+        # f32 [B,H,S,S] logits alone exceed HBM at this depth.
         config = TransformerConfig(
             vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
             n_kv_heads=8, d_ff=5632, max_seq_len=2048, lora_rank=16,
-            remat=False,
+            remat=False, attention_impl="flash", fused_norms=True,
+            scan_layers=False,
         )
         batch, seq = 4, 1024
     else:

@@ -101,11 +101,13 @@ def measure_throughput(
             run_steps, donate_argnums=(0,), out_shardings=(shardings, None)
         ).lower(state, placed, rng).compile()
         if flops_per_step is None:
-            # XLA counts the steps-scan body once, so the program's total
-            # IS one step's flops. Caveat inherited from cost analysis:
-            # models with their own inner scans (scan_layers) undercount —
-            # pass an analytic flops_per_step (utils.flops) for those.
-            flops_per_step = flops_lib.compiled_flops(run_fn)
+            # Transformer family: analytic count (inner layer scans and
+            # pallas kernels defeat cost analysis). Others: XLA cost
+            # analysis of the compiled program — the steps-scan body is
+            # counted once, so the program total IS one step's flops.
+            flops_per_step = flops_lib.model_train_flops(
+                model, batch, compiled=run_fn, n_devices=len(devices)
+            )
         # Warmup call (also verifies the donated-state round trip).
         state, loss = run_fn(state, placed, rng)
         float(jax.device_get(loss))

@@ -100,7 +100,16 @@ class ParquetDataset:
         # ((N // world) // batch), known from metadata alone.
         max_batches = None
         if self.world_size > 1 and not self.repeat:
-            max_batches = (self.num_samples() // self.world_size) // self.batch_size
+            total = self.num_samples()
+            max_batches = (total // self.world_size) // self.batch_size
+            if max_batches == 0 and total > 0:
+                _logger.warning(
+                    "ParquetDataset: %d rows over world_size=%d yields "
+                    "fewer than batch_size=%d rows per rank — every rank "
+                    "emits ZERO batches (training would do no steps). "
+                    "Shrink batch_size/world_size or set repeat=True.",
+                    total, self.world_size, self.batch_size,
+                )
         emitted = 0
         # Buffers persist across epochs under repeat=True, so ranks whose
         # per-epoch row count is below batch_size still make progress (and

@@ -32,6 +32,9 @@ class TorchParquetDataset(IterableDataset):
     # The pytorch worker reads this to build the DataLoader with
     # batch_size=None (batches come pre-assembled).
     yields_batches = True
+    # Marker for the worker's duplicate-data check: sharding happens
+    # inside __iter__ (live process-group rank), not via attributes.
+    shards_by_rank = True
 
     def __init__(self, dataset: ParquetDataset) -> None:
         super().__init__()

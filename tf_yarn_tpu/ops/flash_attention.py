@@ -102,13 +102,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * softmax_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # Matmul operands stay in their native dtype (bf16 inputs run the
+        # MXU at full rate; f32 operands would quarter it) with f32
+        # accumulation via preferred_element_type; scaling/softmax happen
+        # on the f32 logits.
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         logits = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        ) * softmax_scale  # (block_q, block_k)
         if causal:
             logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
         m_prev = m_scr[...]
@@ -121,7 +125,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             jnp.sum(p, axis=-1, keepdims=True), m_prev.shape
         )
         acc_scr[...] = acc_scr[...] * correction[:, :1] + lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -136,6 +140,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 def _check_blocks(s_q, s_kv, block_q, block_k):
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)
+    # Fold oversized defaults down to a divisor (e.g. S=768 with the 512
+    # default → 256) rather than erroring; below the 128-lane tile it's a
+    # genuine shape problem.
+    while block_q >= 256 and s_q % block_q:
+        block_q //= 2
+    while block_k >= 256 and s_kv % block_k:
+        block_k //= 2
     if s_q % block_q or s_kv % block_k:
         raise ValueError(
             f"flash attention needs seq lengths divisible by blocks: "
@@ -244,14 +255,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * softmax_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype matmul operands, f32 accumulation (see _fwd_kernel).
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
         logits = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * softmax_scale
         if causal:
             logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
         p = jnp.exp(logits - lse_ref[0][:, :1])
@@ -261,7 +273,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )
         ds = p * (dp - delta_ref[0][:, :1])
         dq_scr[...] += lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -294,19 +306,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32) * softmax_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # Native-dtype matmul operands, f32 accumulation (see _fwd_kernel).
+        q = q_ref[0, 0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0, 0]
         logits = lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * softmax_scale
         if causal:
             logits = _causal_mask(logits, q_block_idx * block_q, kv_idx * block_k)
         p = jnp.exp(logits - lse_ref[0, 0][:, :1])  # (bq, bk)
         dv_scr[...] += lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bk, d)
         dp = lax.dot_general(
@@ -314,15 +327,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0, 0][:, :1])
-        # q here is pre-scaled, so ds^T @ q == softmax_scale * ds^T @ q_raw.
         dk_scr[...] += lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bk, d)
 
     @pl.when(j == num_j - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        # q entered the dot unscaled, so fold softmax_scale into dk here.
+        dk_ref[0] = (dk_scr[...] * softmax_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -472,12 +485,19 @@ def flash_attention(
     *,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise (flash) attention, differentiable via pallas backward
-    kernels that recompute probabilities from the saved log-sum-exp."""
+    kernels that recompute probabilities from the saved log-sum-exp.
+
+    Default blocks are 512x512 (clamped to the sequence): measured on
+    v5e, 128x128 tiles are grid-overhead-bound — 512 is ~1.8x faster at
+    S=1024 and ~3.7x at S=8192, and beats XLA attention from S=1024 up
+    (25x at S=8192, where XLA's materialized logits thrash HBM). VMEM
+    per tile stays ~1.5MB (logits f32 + operands bf16 + f32 scratch).
+    """
     if softmax_scale is None:
         softmax_scale = query.shape[-1] ** -0.5
     if interpret is None:

@@ -90,9 +90,10 @@ def _train_one_rank(experiment, params: TaskParameters) -> None:
             # WebDataset case via WebLoader, worker.py:50-65; here any
             # IterableDataset works, incl. data.torch_adapter's parquet
             # bridge). Pre-batched iterables pass through unbatched.
-            if params.world_size > 1 and not any(
-                hasattr(dataset, attr)
-                for attr in ("rank", "world_size", "yields_batches")
+            if params.world_size > 1 and not (
+                hasattr(dataset, "rank")
+                or hasattr(dataset, "world_size")
+                or getattr(dataset, "shards_by_rank", False)
             ):
                 # No sampler can shard an iterable: a dataset that isn't
                 # rank-aware feeds every rank the FULL stream (world_size x

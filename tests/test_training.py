@@ -37,6 +37,32 @@ def test_train_loss_decreases_fsdp8():
     assert 0.0 <= metrics["accuracy"] <= 1.0
 
 
+def test_steps_per_loop_matches_single_step():
+    # The scanned multi-step path must be bit-for-bit the same training
+    # computation: same synthetic stream (seeded), same rng folding (the
+    # step counter travels in TrainState), so the final loss must agree
+    # with the plain one-step-per-dispatch loop.
+    devices = select_devices(8, platform="cpu")
+    # log_every=4 aligns with the chunk; train_steps=22 leaves a 2-step
+    # tail that must drain through the single-step path.
+    single = _mnist_core(train_steps=22, log_every_steps=4)
+    chunked = _mnist_core(train_steps=22, log_every_steps=4, steps_per_loop=4)
+    m1 = train_and_evaluate(single, devices=devices)
+    m2 = train_and_evaluate(chunked, devices=devices)
+    np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=1e-5)
+
+
+def test_steps_per_loop_uneven_cadence():
+    # steps_per_loop that does NOT divide the log cadence: chunks stop
+    # short of each boundary and singles finish the stretch; training
+    # still completes the exact step count.
+    core = _mnist_core(train_steps=25, log_every_steps=10, steps_per_loop=4)
+    metrics = train_and_evaluate(
+        core, devices=select_devices(8, platform="cpu")
+    )
+    assert np.isfinite(metrics["loss"])
+
+
 def test_train_mixed_mesh_dp_fsdp_tp():
     core = _mnist_core(mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2), train_steps=30)
     metrics = train_and_evaluate(core, devices=select_devices(8, platform="cpu"))

@@ -50,6 +50,12 @@ class TrainParams:
     # gradients before the single optimizer update (HBM for batch size).
     # Global batch must divide by N x the data-axis sharding.
     grad_accum_steps: int = 1
+    # Run N train steps inside ONE jitted program (lax.scan over a stacked
+    # batch block) between host events — amortizes per-step dispatch the
+    # way TF's steps-per-loop does. Host work (logging, checkpoints, eval)
+    # still happens on its configured cadence: chunks never cross those
+    # boundaries. Costs N staged batches of extra HBM.
+    steps_per_loop: int = 1
 
 
 @dataclasses.dataclass

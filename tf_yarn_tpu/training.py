@@ -347,11 +347,12 @@ def train_and_evaluate(
             ckpt_writer = ckpt_lib.CheckpointWriter(params_cfg.keep_last_n)
             _cleanup.callback(ckpt_writer.close)
 
+        step_fn_raw = build_train_step(
+            core.model, core.loss_fn, core.optimizer,
+            grad_accum_steps=params_cfg.grad_accum_steps,
+        )
         train_step_jit = jax.jit(
-            build_train_step(
-                core.model, core.loss_fn, core.optimizer,
-                grad_accum_steps=params_cfg.grad_accum_steps,
-            ),
+            step_fn_raw,
             donate_argnums=(0,),
             out_shardings=(state_shardings, None),
         )
@@ -360,6 +361,60 @@ def train_and_evaluate(
         train_step = train_step_jit.lower(
             state, first_global, train_rng
         ).compile()
+
+        # steps_per_loop > 1: a second executable scanning a whole block of
+        # steps over stacked batches, so per-step dispatch (a real cost on
+        # remote/relayed backends, and non-zero everywhere) amortizes away.
+        steps_per_loop = max(1, params_cfg.steps_per_loop)
+        # Cadences that actually surface to the host this run (mirrors the
+        # trigger conditions in the loop below).
+        host_cadences = [
+            c for c in (
+                params_cfg.log_every_steps,
+                params_cfg.checkpoint_every_steps if core.model_dir else None,
+                params_cfg.eval_every_steps if core.eval_input_fn else None,
+            ) if c
+        ]
+        if steps_per_loop > 1 and host_cadences:
+            cap = min(host_cadences)
+            if steps_per_loop > cap:
+                # Chunks never cross host boundaries, so a longer chunk
+                # would simply never run (while still paying its compile).
+                _logger.warning(
+                    "steps_per_loop=%d exceeds the smallest host cadence "
+                    "(%d); clamping", steps_per_loop, cap,
+                )
+                steps_per_loop = cap
+        multi_step = None
+        stacked_shardings = None
+        if steps_per_loop > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _stack_sharding(leaf):
+                spec = getattr(leaf.sharding, "spec", PartitionSpec())
+                return NamedSharding(mesh, PartitionSpec(None, *spec))
+
+            stacked_shardings = jax.tree_util.tree_map(
+                _stack_sharding, first_global
+            )
+            stacked_abstract = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.ShapeDtypeStruct(
+                    (steps_per_loop,) + leaf.shape, leaf.dtype, sharding=sh
+                ),
+                first_global, stacked_shardings,
+            )
+
+            def run_chunk(state, stacked, rng):
+                def body(s, b):
+                    return step_fn_raw(s, b, rng)
+                state, ms = jax.lax.scan(body, state, stacked)
+                # Last step's metrics: chunks end exactly on log boundaries.
+                return state, jax.tree_util.tree_map(lambda x: x[-1], ms)
+
+            multi_step = jax.jit(
+                run_chunk, donate_argnums=(0,),
+                out_shardings=(state_shardings, None),
+            ).lower(state, stacked_abstract, train_rng).compile()
         flops_per_step = flops_lib.model_train_flops(
             core.model, first_global, train_step,
             n_devices=int(mesh.devices.size),
@@ -396,25 +451,79 @@ def train_and_evaluate(
         )
         warned_ragged = False
         step = resume_step
+        input_exhausted = False
+
+        def record(b):
+            leaves = jax.tree_util.tree_leaves(b)
+            hook.record_batch(leaves[0].shape[0] if leaves else None)
+
+        def run_single(state, b):
+            nonlocal warned_ragged
+            shapes = tuple(a.shape for a in jax.tree_util.tree_leaves(b))
+            record(b)
+            if shapes == expected_shapes:
+                return train_step(state, b, train_rng)
+            # Ragged batch (e.g. epoch tail): the AOT executable is
+            # shape-locked, fall back to the retracing jit path.
+            if not warned_ragged:
+                warned_ragged = True
+                _logger.warning(
+                    "batch shapes changed mid-run; recompiling. Use "
+                    "fixed-size batches (drop the epoch tail) on TPU."
+                )
+            return train_step_jit(state, b, train_rng)
+
+        def next_host_boundary(at):
+            """First step > `at` where the loop must surface to the host."""
+            boundary = params_cfg.train_steps
+            for every in host_cadences:
+                boundary = min(boundary, (at // every + 1) * every)
+            return boundary
+
         try:
             while step < params_cfg.train_steps:
-                shapes = tuple(
-                    a.shape for a in jax.tree_util.tree_leaves(batch)
-                )
-                hook.record_batch(shapes[0][0] if shapes else None)
-                if shapes == expected_shapes:
-                    state, metrics = train_step(state, batch, train_rng)
-                else:
-                    # Ragged batch (e.g. epoch tail): the AOT executable is
-                    # shape-locked, fall back to the retracing jit path.
-                    if not warned_ragged:
-                        warned_ragged = True
-                        _logger.warning(
-                            "batch shapes changed mid-run; recompiling. Use "
-                            "fixed-size batches (drop the epoch tail) on TPU."
+                ran_chunk = False
+                if (
+                    multi_step is not None
+                    and next_host_boundary(step) - step >= steps_per_loop
+                ):
+                    chunk = [batch]
+                    while len(chunk) < steps_per_loop:
+                        try:
+                            chunk.append(next(batch_iter))
+                        except StopIteration:
+                            input_exhausted = True
+                            break
+                    uniform = all(
+                        tuple(a.shape for a in jax.tree_util.tree_leaves(b))
+                        == expected_shapes
+                        for b in chunk
+                    )
+                    if len(chunk) == steps_per_loop and uniform:
+                        import jax.numpy as jnp
+
+                        stacked = jax.device_put(
+                            jax.tree_util.tree_map(
+                                lambda *xs: jnp.stack(xs), *chunk
+                            ),
+                            stacked_shardings,
                         )
-                    state, metrics = train_step_jit(state, batch, train_rng)
-                step += 1
+                        for b in chunk:
+                            record(b)
+                        state, metrics = multi_step(state, stacked, train_rng)
+                        step += steps_per_loop
+                        ran_chunk = True
+                    else:
+                        # Short/ragged tail: drain what was pulled one by
+                        # one (host events can't fall inside — the chunk
+                        # window sat strictly before the next boundary).
+                        for b in chunk:
+                            state, metrics = run_single(state, b)
+                            step += 1
+                        ran_chunk = True
+                if not ran_chunk:
+                    state, metrics = run_single(state, batch)
+                    step += 1
                 if (
                     step % params_cfg.log_every_steps == 0
                     or step == params_cfg.train_steps
@@ -446,6 +555,9 @@ def train_and_evaluate(
                         for key, value in eval_metrics.items():
                             tb_writer.add_scalar(f"eval/{key}", value, step)
                 if step < params_cfg.train_steps:
+                    if input_exhausted:
+                        _logger.info("input exhausted at step %d", step)
+                        break
                     try:
                         batch = next(batch_iter)
                     except StopIteration:

@@ -93,3 +93,35 @@ def test_generate_gqa_and_lora_configs():
     )
     assert out.shape == (2, 8)
     assert (np.asarray(out) >= 0).all()
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_int8_kv_cache_decode_close_to_exact(scan_layers):
+    # Int8 KV cache (ops/quantize.py wired into the decode path): per-row
+    # symmetric quantization bounds relative error at ~1/127 per entry, so
+    # decode logits must track the exact bf16-cache logits closely.
+    model, params = _model_and_params(scan_layers, kv_cache_dtype="int8")
+    exact_model, _ = _model_and_params(scan_layers)
+    rng = np.random.RandomState(1)
+    seq = jnp.asarray(rng.randint(0, 256, (2, 10)), jnp.int32)
+
+    logits_q, state = model.apply(params, seq, decode=True, mutable=["cache"])
+    logits_exact, _ = exact_model.apply(
+        params, seq, decode=True, mutable=["cache"]
+    )
+    # Cache really stores int8 values (+ f32 scales).
+    leaves = jax.tree_util.tree_leaves(state["cache"])
+    assert any(leaf.dtype == jnp.int8 for leaf in leaves)
+    err = np.max(np.abs(np.asarray(logits_q) - np.asarray(logits_exact)))
+    spread = np.max(np.abs(np.asarray(logits_exact))) + 1e-6
+    assert err / spread < 0.15, (err, spread)
+
+
+def test_generate_with_int8_kv_cache():
+    model, params = _model_and_params(scan_layers=False, kv_cache_dtype="int8")
+    out = generate(
+        model, params, jnp.asarray([[5, 9, 2]], jnp.int32), max_new_tokens=5,
+        temperature=0.0,
+    )
+    assert out.shape == (1, 8)
+    assert (np.asarray(out) >= 0).all()

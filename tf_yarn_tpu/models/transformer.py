@@ -57,6 +57,11 @@ class TransformerConfig:
     # Fused pallas RMSNorm (ops/rmsnorm.py). Opt-in: best on single-chip /
     # shard_map paths; under pjit the XLA-fused norm already performs well.
     fused_norms: bool = False
+    # KV-cache storage for autoregressive decode: "bf16" (exact) or
+    # "int8" (per-row symmetric quantization via ops/quantize.py — halves
+    # cache HBM and its read traffic, the decode bottleneck at long
+    # context; dequant fuses into the attention input).
+    kv_cache_dtype: str = "bf16"
     # GPipe schedule for the layer stack over the pp mesh axis: >0 sets the
     # microbatch count and routes the blocks through
     # parallel.pipeline.pipeline_apply (overlapped stages) instead of the
@@ -202,18 +207,26 @@ class Attention(nn.Module):
             # KV cache for autoregressive decoding: append this call's
             # keys/values at cache_index, attend against the whole cache
             # (future slots masked by the offset causal mask).
+            int8_cache = cfg.kv_cache_dtype == "int8"
+            cache_shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+            store_dtype = jnp.int8 if int8_cache else cfg.dtype
             cached_k = self.variable(
-                "cache", "cached_key",
-                lambda: jnp.zeros(
-                    (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype
-                ),
+                "cache", "cached_key", lambda: jnp.zeros(cache_shape, store_dtype)
             )
             cached_v = self.variable(
                 "cache", "cached_value",
-                lambda: jnp.zeros(
-                    (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype
-                ),
+                lambda: jnp.zeros(cache_shape, store_dtype),
             )
+            if int8_cache:
+                scale_shape = cache_shape[:-1] + (1,)
+                k_scale = self.variable(
+                    "cache", "cached_key_scale",
+                    lambda: jnp.zeros(scale_shape, jnp.float32),
+                )
+                v_scale = self.variable(
+                    "cache", "cached_value_scale",
+                    lambda: jnp.zeros(scale_shape, jnp.float32),
+                )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -222,15 +235,39 @@ class Attention(nn.Module):
             positions = jnp.broadcast_to(positions, (b, s))
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
-            )
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
-            )
+
+            def _append(var, fresh):
+                var.value = jax.lax.dynamic_update_slice(
+                    var.value, fresh, (0, idx, 0, 0)
+                )
+
+            if int8_cache:
+                # Per-(position, head) rows over head_dim (ops/quantize.py
+                # pallas kernel); dequant below fuses into the attention
+                # input, so HBM holds (and streams) half the bytes.
+                from tf_yarn_tpu.ops.quantize import quantize_int8
+
+                k_q, k_s = quantize_int8(k.astype(jnp.float32))
+                v_q, v_s = quantize_int8(v.astype(jnp.float32))
+                _append(cached_k, k_q)
+                _append(cached_v, v_q)
+                _append(k_scale, k_s)
+                _append(v_scale, v_s)
+                key_all = (
+                    cached_k.value.astype(cfg.dtype)
+                    * k_scale.value.astype(cfg.dtype)
+                )
+                value_all = (
+                    cached_v.value.astype(cfg.dtype)
+                    * v_scale.value.astype(cfg.dtype)
+                )
+            else:
+                _append(cached_k, k.astype(cfg.dtype))
+                _append(cached_v, v.astype(cfg.dtype))
+                key_all, value_all = cached_k.value, cached_v.value
             cache_index.value = idx + s
             out = xla_attention(
-                q, cached_k.value, cached_v.value, causal=True, segment_offset=idx
+                q, key_all, value_all, causal=True, segment_offset=idx
             )
         else:
             q = rope(q, positions, cfg.rope_theta)

@@ -375,14 +375,16 @@ def train_and_evaluate(
                 params_cfg.eval_every_steps if core.eval_input_fn else None,
             ) if c
         ]
-        if steps_per_loop > 1 and host_cadences:
-            cap = min(host_cadences)
+        if steps_per_loop > 1:
+            # Chunks never cross host boundaries (nor the end of the run),
+            # so a longer chunk would simply never execute while still
+            # paying the largest compile of the run.
+            cap = min(host_cadences
+                      + [max(1, params_cfg.train_steps - resume_step)])
             if steps_per_loop > cap:
-                # Chunks never cross host boundaries, so a longer chunk
-                # would simply never run (while still paying its compile).
                 _logger.warning(
-                    "steps_per_loop=%d exceeds the smallest host cadence "
-                    "(%d); clamping", steps_per_loop, cap,
+                    "steps_per_loop=%d exceeds the smallest host cadence / "
+                    "remaining steps (%d); clamping", steps_per_loop, cap,
                 )
                 steps_per_loop = cap
         multi_step = None
@@ -415,6 +417,21 @@ def train_and_evaluate(
                 run_chunk, donate_argnums=(0,),
                 out_shardings=(state_shardings, None),
             ).lower(state, stacked_abstract, train_rng).compile()
+
+            # Stacking must happen INSIDE jit: multi-host global Arrays are
+            # not fully addressable, so eager per-op dispatch on them
+            # raises; a jitted stack with explicit out_shardings works on
+            # one process and many alike.
+            def _stack(*bs):
+                import jax.numpy as jnp
+
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *bs
+                )
+
+            stack_batches = jax.jit(
+                _stack, out_shardings=stacked_shardings
+            )
         flops_per_step = flops_lib.model_train_flops(
             core.model, first_global, train_step,
             n_devices=int(mesh.devices.size),
@@ -500,14 +517,7 @@ def train_and_evaluate(
                         for b in chunk
                     )
                     if len(chunk) == steps_per_loop and uniform:
-                        import jax.numpy as jnp
-
-                        stacked = jax.device_put(
-                            jax.tree_util.tree_map(
-                                lambda *xs: jnp.stack(xs), *chunk
-                            ),
-                            stacked_shardings,
-                        )
+                        stacked = stack_batches(*chunk)
                         for b in chunk:
                             record(b)
                         state, metrics = multi_step(state, stacked, train_rng)

@@ -169,6 +169,39 @@ def bench_llama_lora(tpu: bool):
     )
 
 
+def bench_long_context(tpu: bool):
+    """Long-sequence training on one chip: flash attention + chunked-vocab
+    loss are what make S=8192 fit (xla attention's f32 logits alone would
+    be 32 GiB here). Reported as tokens/sec/chip."""
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+
+    if tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=8192, remat=False,
+            attention_impl="flash", fused_norms=True, scan_layers=False,
+        )
+        batch, seq, steps = 1, 8192, 10
+    else:
+        config = TransformerConfig.tiny(attention_impl="flash")
+        batch, seq, steps = 2, 64, 3
+    rng = np.random.RandomState(0)
+    stats = measure_throughput(
+        Transformer(config),
+        common.lm_loss_chunked,
+        optax.adamw(1e-4),
+        {"tokens": rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)},
+        steps=steps,
+    )
+    stats["tokens_per_sec_per_chip"] = stats["samples_per_sec_per_chip"] * seq
+    return stats
+
+
 def bench_ici_allreduce(tpu: bool):
     from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -184,6 +217,7 @@ CONFIGS = {
     "bert_base": bench_bert_base,
     "resnet50": bench_resnet50,
     "llama_lora": bench_llama_lora,
+    "long_context": bench_long_context,
     "ici_allreduce": bench_ici_allreduce,
 }
 

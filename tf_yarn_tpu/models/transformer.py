@@ -59,8 +59,8 @@ class TransformerConfig:
     fused_norms: bool = False
     # KV-cache storage for autoregressive decode: "bf16" (exact) or
     # "int8" (per-row symmetric quantization via ops/quantize.py — halves
-    # cache HBM and its read traffic, the decode bottleneck at long
-    # context; dequant fuses into the attention input).
+    # the cache's resident HBM, i.e. 2x context length per chip; stream
+    # traffic is unchanged until a decode kernel reads int8 directly).
     kv_cache_dtype: str = "bf16"
     # GPipe schedule for the layer stack over the pp mesh axis: >0 sets the
     # microbatch count and routes the blocks through
@@ -207,6 +207,11 @@ class Attention(nn.Module):
             # KV cache for autoregressive decoding: append this call's
             # keys/values at cache_index, attend against the whole cache
             # (future slots masked by the offset causal mask).
+            if cfg.kv_cache_dtype not in ("bf16", "int8"):
+                raise ValueError(
+                    f"kv_cache_dtype={cfg.kv_cache_dtype!r}: expected "
+                    "'bf16' or 'int8'"
+                )
             int8_cache = cfg.kv_cache_dtype == "int8"
             cache_shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
             store_dtype = jnp.int8 if int8_cache else cfg.dtype
@@ -243,9 +248,15 @@ class Attention(nn.Module):
 
             if int8_cache:
                 # Per-(position, head) rows over head_dim (ops/quantize.py
-                # pallas kernel); dequant below fuses into the attention
-                # input, so HBM holds (and streams) half the bytes.
-                from tf_yarn_tpu.ops.quantize import quantize_int8
+                # pallas kernel). What this buys today is cache *capacity*
+                # — half the resident HBM, so 2x the context per chip; the
+                # dequantized operands below still materialize for the
+                # attention dot, so per-step stream traffic is not reduced
+                # until a decode kernel consumes int8+scales directly.
+                from tf_yarn_tpu.ops.quantize import (
+                    dequantize_int8,
+                    quantize_int8,
+                )
 
                 k_q, k_s = quantize_int8(k.astype(jnp.float32))
                 v_q, v_s = quantize_int8(v.astype(jnp.float32))
@@ -253,13 +264,11 @@ class Attention(nn.Module):
                 _append(cached_v, v_q)
                 _append(k_scale, k_s)
                 _append(v_scale, v_s)
-                key_all = (
-                    cached_k.value.astype(cfg.dtype)
-                    * k_scale.value.astype(cfg.dtype)
+                key_all = dequantize_int8(
+                    cached_k.value, k_scale.value, cfg.dtype
                 )
-                value_all = (
-                    cached_v.value.astype(cfg.dtype)
-                    * v_scale.value.astype(cfg.dtype)
+                value_all = dequantize_int8(
+                    cached_v.value, v_scale.value, cfg.dtype
                 )
             else:
                 _append(cached_k, k.astype(cfg.dtype))

@@ -70,3 +70,54 @@ def test_transformer_with_fused_norms():
                                               remat=False)
     ref = transformer.Transformer(cfg2).apply(variables, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_int8_decode_attention_matches_xla():
+    # Kernel correctness isolated from quantization error: the reference
+    # attends over the DEQUANTIZED cache, so outputs must match to
+    # reduction-order noise.
+    from tf_yarn_tpu.ops.attention import xla_attention
+    from tf_yarn_tpu.ops.decode_attention import int8_decode_attention
+    from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    B, S, H, Hkv, D = 2, 256, 8, 4, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    kq, ks = quantize_int8(k)
+    vq, vs = quantize_int8(v)
+    k_deq = dequantize_int8(kq, ks, jnp.float32)
+    v_deq = dequantize_int8(vq, vs, jnp.float32)
+
+    for length in (1, 96, 173, 256):
+        out = int8_decode_attention(q, kq, ks, vq, vs, length, block_k=64)
+        ref = xla_attention(
+            q[:, None], k_deq[:, :length], v_deq[:, :length],
+            causal=True, segment_offset=length - 1,
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4,
+            err_msg=f"length={length}",
+        )
+
+
+def test_int8_decode_attention_gqa_group_mapping():
+    # Each q-head group must read ITS kv head: make kv heads wildly
+    # different scales and check groups diverge accordingly.
+    from tf_yarn_tpu.ops.decode_attention import int8_decode_attention
+    from tf_yarn_tpu.ops.quantize import quantize_int8
+
+    B, S, H, Hkv, D = 1, 128, 4, 2, 64
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    v = np.zeros((B, S, Hkv, D), np.float32)
+    v[:, :, 0] = 1.0
+    v[:, :, 1] = -3.0
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    kq, ks = quantize_int8(k)
+    vq, vs = quantize_int8(jnp.asarray(v))
+    out = np.asarray(int8_decode_attention(q, kq, ks, vq, vs, 128, block_k=64))
+    # Heads 0-1 (group of kv head 0) average v=1; heads 2-3 see v=-3.
+    np.testing.assert_allclose(out[0, :2], 1.0, atol=2e-2)
+    np.testing.assert_allclose(out[0, 2:], -3.0, atol=6e-2)

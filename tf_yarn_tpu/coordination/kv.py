@@ -275,29 +275,88 @@ class KVServer:
 class KVClient(KVStore):
     """Socket client for :class:`KVServer` / native coordd.
 
-    Uses one connection per in-flight request (requests are infrequent
-    control-plane traffic; blocking `wait` calls would otherwise serialize
-    behind each other on a shared connection).
+    Read-only ops (get/events/keys/ping) share ONE persistent connection
+    under a lock — metric pollers and event listeners issue these every
+    few seconds, and per-request connects were pure overhead; a stale
+    pooled socket is dropped and the (idempotent) request retried once.
+    Blocking `wait` calls get a dedicated connection each (they can park
+    for minutes and would serialize everyone else), and mutating ops
+    (put/incr/del/shutdown) also use fresh connections: retrying them
+    after a mid-reply failure could apply the mutation twice (duplicate
+    event-log entries, double-incremented rank tickets).
     """
 
     def __init__(self, endpoint: str, connect_timeout: float = 30.0) -> None:
         host, _, port = endpoint.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
 
     @property
     def endpoint(self) -> str:
         return f"{self._addr[0]}:{self._addr[1]}"
 
+    def close(self) -> None:
+        with self._lock:
+            self._drop_pooled_locked()
+
+    def _drop_pooled_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, sock: socket.socket, req: dict) -> dict:
+        _send_frame(sock, req)
+        return _recv_frame(sock)
+
+    _POOLED_OPS = frozenset({"get", "events", "keys", "ping"})
+
     def _request(self, req: dict, timeout: Optional[float] = None) -> dict:
-        sock = socket.create_connection(self._addr, timeout=self._connect_timeout)
-        try:
-            # Blocking waits need the socket timeout to outlive the wait.
-            sock.settimeout(None if timeout is None else timeout + 5.0)
-            _send_frame(sock, req)
-            reply = _recv_frame(sock)
-        finally:
-            sock.close()
+        op = req.get("op")
+        if op not in self._POOLED_OPS:
+            # `wait` may block server-side until the key appears (socket
+            # timeout must outlive it); mutations must be at-most-once, so
+            # no pooled-socket reuse/retry for them either.
+            sock = socket.create_connection(
+                self._addr, timeout=self._connect_timeout
+            )
+            try:
+                if op == "wait":
+                    # Must outlive the server-side wait (None = unbounded).
+                    sock.settimeout(None if timeout is None else timeout + 5.0)
+                else:
+                    sock.settimeout(self._connect_timeout)
+                reply = self._roundtrip(sock, req)
+            finally:
+                sock.close()
+        else:
+            with self._lock:
+                reply = None
+                for attempt in (0, 1):
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            self._addr, timeout=self._connect_timeout
+                        )
+                        self._sock.settimeout(self._connect_timeout)
+                    try:
+                        reply = self._roundtrip(self._sock, req)
+                        break
+                    except (ConnectionError, OSError):
+                        # Stale pooled socket (server restart, idle
+                        # reset): drop it; these ops are idempotent, so
+                        # retry once on a fresh connection.
+                        self._drop_pooled_locked()
+                        if attempt:
+                            raise
+                    except Exception:
+                        # Framing/parse failure mid-stream: the socket may
+                        # hold unread bytes — never reuse it.
+                        self._drop_pooled_locked()
+                        raise
         if not reply.get("ok"):
             if reply.get("timeout"):
                 raise KVTimeoutError(reply.get("error", "wait timed out"))

@@ -248,11 +248,8 @@ class Attention(nn.Module):
 
             if int8_cache:
                 # Per-(position, head) rows over head_dim (ops/quantize.py
-                # pallas kernel). What this buys today is cache *capacity*
-                # — half the resident HBM, so 2x the context per chip; the
-                # dequantized operands below still materialize for the
-                # attention dot, so per-step stream traffic is not reduced
-                # until a decode kernel consumes int8+scales directly.
+                # pallas kernel): half the resident cache HBM, 2x context
+                # per chip.
                 from tf_yarn_tpu.ops.quantize import (
                     dequantize_int8,
                     quantize_int8,
@@ -264,20 +261,41 @@ class Attention(nn.Module):
                 _append(cached_v, v_q)
                 _append(k_scale, k_s)
                 _append(v_scale, v_s)
-                key_all = dequantize_int8(
-                    cached_k.value, k_scale.value, cfg.dtype
-                )
-                value_all = dequantize_int8(
-                    cached_v.value, v_scale.value, cfg.dtype
-                )
             else:
                 _append(cached_k, k.astype(cfg.dtype))
                 _append(cached_v, v.astype(cfg.dtype))
-                key_all, value_all = cached_k.value, cached_v.value
             cache_index.value = idx + s
-            out = xla_attention(
-                q, key_all, value_all, causal=True, segment_offset=idx
-            )
+            if int8_cache and s == 1:
+                # Steady-state decode: the pallas kernel streams the int8
+                # cache directly, dequantizing tile-by-tile in VMEM
+                # instead of materializing a full bf16 copy per token
+                # (ops/decode_attention.py; measured at parity with the
+                # dequant+xla path at B=1 — single-token decode is
+                # latency-bound — while never paying the 2x materialized
+                # cache).
+                from tf_yarn_tpu.ops.decode_attention import (
+                    int8_decode_attention,
+                )
+
+                out = int8_decode_attention(
+                    q[:, 0], cached_k.value, k_scale.value,
+                    cached_v.value, v_scale.value, idx + 1,
+                )[:, None]
+            else:
+                if int8_cache:
+                    # Prefill (s > 1): one-shot dequant, amortized over
+                    # the whole prompt.
+                    key_all = dequantize_int8(
+                        cached_k.value, k_scale.value, cfg.dtype
+                    )
+                    value_all = dequantize_int8(
+                        cached_v.value, v_scale.value, cfg.dtype
+                    )
+                else:
+                    key_all, value_all = cached_k.value, cached_v.value
+                out = xla_attention(
+                    q, key_all, value_all, causal=True, segment_offset=idx
+                )
         else:
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)

@@ -1,0 +1,175 @@
+"""Single-token decode attention over an INT8 KV cache (pallas).
+
+The decode bottleneck at long context is streaming the KV cache from HBM
+every generated token. `models/transformer.py` can *store* the cache as
+int8 + per-row scales (kv_cache_dtype="int8"), but dequantizing outside
+the attention op materializes the full bf16 cache each step — traffic
+goes UP, not down. This kernel closes that loop: it reads the int8
+values and f32 scales directly, dequantizes tile-by-tile in VMEM, and
+runs the online-softmax reduction across kv blocks — so HBM streams half
+the bytes of a bf16 cache.
+
+Layout choices (the part that makes it fast on TPU):
+* K/V enter as ``[B, S, Hkv*D]`` — a FREE reshape of the cache's
+  ``[B, S, Hkv, D]`` storage (no transpose copy of the thing we're
+  trying not to copy). Blocks of shape (1, block_k, Hkv*D) are
+  lane-native (Hkv*D is a multiple of 128 for every config in the zoo).
+* The per-kv-head dots are unrolled in-kernel over the static Hkv range;
+  each head's GQA query group rides the same tile.
+* Valid cache length arrives via scalar prefetch (SMEM), masking dead
+  positions with -inf before the online-softmax update.
+
+Kernel semantics match ``xla_attention(q[:, None], k, v, causal=True,
+segment_offset=length-1)`` for a single query token at position
+``length - 1`` (tested in tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def _decode_kernel(length_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, n_kv: int, group: int,
+                   head_dim: int, block_k: int, softmax_scale: float):
+    """Grid (B, S // block_k); kv-block axis innermost/sequential.
+
+    Refs: q (1, H, D); k/v (1, block_k, Hkv*D) int8; scales (1, block_k,
+    Hkv) f32; out (1, H, D). Scratch: m/l (H, 128) f32, acc (H, D) f32.
+    """
+    ki = pl.program_id(1)
+    num_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = length_ref[0]
+    # Positions of this kv block; everything at/after `length` is dead
+    # (cache slots not yet written).
+    pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    live_row = pos < length  # (1, block_k)
+
+    @pl.when(ki * block_k < length)
+    def _step():
+        for h in range(n_kv):
+            k_blk = k_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            v_blk = v_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            scale_k = ks_ref[0, :, h:h + 1]  # (block_k, 1) f32
+            scale_v = vs_ref[0, :, h:h + 1]
+            # Dequant in VMEM: int8 -> f32 rows * per-row scale.
+            k_f = k_blk.astype(jnp.float32) * scale_k
+            v_f = v_blk.astype(jnp.float32) * scale_v
+            q_h = q_ref[0, h * group:(h + 1) * group, :].astype(jnp.float32)
+            logits = lax.dot_general(
+                q_h * softmax_scale, k_f, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (group, block_k)
+            logits = jnp.where(live_row, logits, NEG_INF)
+
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m_scr[rows]                      # (group, 128)
+            m_blk = jnp.max(logits, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_blk, m_prev.shape))
+            p = jnp.exp(logits - m_new[:, :1])
+            corr = jnp.exp(m_prev - m_new)
+            m_scr[rows] = m_new
+            l_scr[rows] = l_scr[rows] * corr + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), m_prev.shape
+            )
+            acc_scr[rows] = acc_scr[rows] * corr[:, :1] + lax.dot_general(
+                p, v_f, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+
+
+def int8_decode_attention(
+    query: jax.Array,
+    key_q: jax.Array,
+    key_scale: jax.Array,
+    value_q: jax.Array,
+    value_scale: jax.Array,
+    length: jax.Array,
+    *,
+    softmax_scale: Optional[float] = None,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """query [B, H, D] (one token/batch row), int8 cache [B, S, Hkv, D]
+    + scales [B, S, Hkv, 1], length scalar int32 (valid positions) ->
+    [B, H, D] attention output in `query`'s dtype."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    import math
+
+    b, n_heads, head_dim = query.shape
+    _, s, n_kv, _ = key_q.shape
+    group = n_heads // n_kv
+    # Fold to a divisor of the cache length (e.g. S=768 -> 256) instead of
+    # raising: any S the cache can hold must decode.
+    block_k = math.gcd(s, min(block_k, s))
+    if softmax_scale is None:
+        softmax_scale = head_dim**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kf = key_q.reshape(b, s, n_kv * head_dim)
+    vf = value_q.reshape(b, s, n_kv * head_dim)
+    ks = key_scale.reshape(b, s, n_kv)
+    vs = value_scale.reshape(b, s, n_kv)
+    length = jnp.asarray(length, jnp.int32).reshape((1,))
+
+    kernel = functools.partial(
+        _decode_kernel, n_kv=n_kv, group=group, head_dim=head_dim,
+        block_k=block_k, softmax_scale=softmax_scale,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, n_heads, head_dim), lambda bi, ki, length: (bi, 0, 0)),
+            pl.BlockSpec((1, block_k, n_kv * head_dim),
+                         lambda bi, ki, length: (bi, ki, 0)),
+            pl.BlockSpec((1, block_k, n_kv), lambda bi, ki, length: (bi, ki, 0)),
+            pl.BlockSpec((1, block_k, n_kv * head_dim),
+                         lambda bi, ki, length: (bi, ki, 0)),
+            pl.BlockSpec((1, block_k, n_kv), lambda bi, ki, length: (bi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_heads, head_dim), lambda bi, ki, length: (bi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, head_dim), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, head_dim), query.dtype),
+        interpret=interpret,
+        compiler_params=(
+            None
+            if interpret
+            else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+        ),
+    )(length, query, kf, ks, vf, vs)
+    return out

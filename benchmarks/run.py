@@ -169,6 +169,34 @@ def bench_llama_lora(tpu: bool):
     )
 
 
+def bench_dlrm_clicks(tpu: bool):
+    """Deep CTR on the Criteo-clicks shape: 26 embedding tables stacked
+    into one fsdp-sharded param + MXU pairwise interaction."""
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models.dlrm import DLRM, DLRMConfig, dlrm_loss
+
+    config = DLRMConfig.criteo() if tpu else DLRMConfig.tiny()
+    batch = 4096 if tpu else 256
+    rng = np.random.RandomState(0)
+    sizes = np.asarray(config.table_sizes)
+    model = DLRM(config)
+    return measure_throughput(
+        model,
+        dlrm_loss,
+        optax.adagrad(1e-3),
+        {
+            "cat": rng.randint(0, sizes, (batch, len(sizes))).astype(np.int32),
+            "dense": rng.randn(batch, config.n_dense).astype(np.float32),
+            "y": rng.randint(0, 2, batch).astype(np.int32),
+        },
+        init_fn=lambda r, b: model.init(r, b["cat"], b["dense"]),
+        steps=10 if tpu else 5,
+    )
+
+
 def bench_long_context(tpu: bool):
     """Long-sequence training on one chip: flash attention + chunked-vocab
     loss are what make S=8192 fit (xla attention's f32 logits alone would
@@ -215,6 +243,7 @@ CONFIGS = {
     "mnist_dense": bench_mnist_dense,
     "linear_clicks": bench_linear_clicks,
     "bert_base": bench_bert_base,
+    "dlrm_clicks": bench_dlrm_clicks,
     "resnet50": bench_resnet50,
     "llama_lora": bench_llama_lora,
     "long_context": bench_long_context,

@@ -1,0 +1,81 @@
+"""Batch-size / knob sweep for the flagship decoder on one chip.
+
+Complements benchmarks/run.py (fixed configs) by sweeping the axes that
+set single-chip MFU: batch size, remat, scan_layers. One JSON line per
+point, so the winner can be promoted into bench.py's headline config.
+
+    python benchmarks/sweep.py --batches 8,16,32
+    python benchmarks/sweep.py --batches 4,8 --seq 2048 --remat
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batches", default="8,16,32")
+    parser.add_argument("--seq", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--d-model", type=int, default=1024)
+    parser.add_argument("--n-layers", type=int, default=8)
+    parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--attention", default="flash", choices=["flash", "xla"])
+    parser.add_argument("--scan-layers", action="store_true")
+    args = parser.parse_args()
+
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+
+    config = TransformerConfig(
+        vocab_size=32000,
+        d_model=args.d_model,
+        n_layers=args.n_layers,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=4 * args.d_model,
+        max_seq_len=max(2048, args.seq),
+        remat=args.remat,
+        attention_impl=args.attention,
+        fused_norms=True,
+        scan_layers=args.scan_layers,
+    )
+    model = Transformer(config)
+    for batch in [int(b) for b in args.batches.split(",")]:
+        tokens = np.random.RandomState(0).randint(
+            0, config.vocab_size, (batch, args.seq), dtype=np.int32
+        )
+        t0 = time.time()
+        try:
+            stats = measure_throughput(
+                model, common.lm_loss, optax.adamw(1e-4),
+                {"tokens": tokens}, steps=args.steps,
+            )
+        except Exception as exc:  # OOM etc. — keep sweeping
+            print(json.dumps({"batch": batch, "seq": args.seq,
+                              "error": f"{type(exc).__name__}: {exc}"[:200]}),
+                  flush=True)
+            continue
+        print(json.dumps({
+            "batch": batch,
+            "seq": args.seq,
+            "samples_per_sec_per_chip": round(stats["samples_per_sec_per_chip"], 2),
+            "step_time_ms": round(stats["step_time_ms"], 2),
+            "mfu": round(stats.get("mfu", 0.0), 4),
+            "wall_s": round(time.time() - t0, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

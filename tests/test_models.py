@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from tf_yarn_tpu.experiment import as_core_experiment
-from tf_yarn_tpu.models import bert, linear, resnet, transformer
+from tf_yarn_tpu.models import bert, dlrm, linear, resnet, transformer
 from tf_yarn_tpu.parallel.mesh import MeshSpec, select_devices
 from tf_yarn_tpu.training import train_and_evaluate
 
@@ -226,6 +226,54 @@ def test_linear_classifier_learns():
     )
     metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
     assert metrics["accuracy"] > 0.6
+
+
+def test_dlrm_forward_shape_and_offsets():
+    cfg = dlrm.DLRMConfig.tiny()
+    model = dlrm.DLRM(cfg)
+    cat = jnp.zeros((2, len(cfg.table_sizes)), jnp.int32)
+    dense = jnp.zeros((2, cfg.n_dense))
+    variables = model.init(jax.random.PRNGKey(0), cat, dense)
+    logits = model.apply(variables, cat, dense)
+    assert logits.shape == (2, 1)
+    assert logits.dtype == jnp.float32
+    # One stacked table covering every per-feature vocabulary.
+    table = variables["params"]["embedding"]
+    assert table.value.shape == (cfg.total_buckets, cfg.embed_dim)
+    # id 0 of table 0 and id 0 of table 1 must hit different rows: max-id
+    # inputs stay in range (offsets are baked in correctly).
+    top = jnp.asarray([[s - 1 for s in cfg.table_sizes]], jnp.int32)
+    out = model.apply(variables, top, dense[:1])
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dlrm_pairs_exclude_self_dots():
+    # n_pairs for F features (+1 bottom row) must be (F+1)F/2 with dense,
+    # F(F-1)/2 without — sized via the top MLP input.
+    cfg = dlrm.DLRMConfig.tiny(top_mlp=(), bottom_mlp=())
+    model = dlrm.DLRM(cfg)
+    n_tables = len(cfg.table_sizes)
+    cat = jnp.zeros((2, n_tables), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), cat, jnp.zeros((2, cfg.n_dense)))
+    head_in = variables["params"]["head"]["kernel"].shape[0]
+    n_feats = n_tables + 1  # + bottom-MLP row
+    assert head_in == cfg.embed_dim + n_feats * (n_feats - 1) // 2
+
+
+def test_dlrm_trains_sharded():
+    exp = dlrm.make_experiment(
+        dlrm.DLRMConfig.tiny(),
+        train_steps=150,
+        batch_size=256,
+        learning_rate=0.2,
+        mesh_spec=MeshSpec(dp=2, fsdp=4),
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
+    assert np.isfinite(metrics["loss"])
+    # Labels are balanced 50/50 (parity of table-0 bucket), so this bar
+    # genuinely requires learning — guessing one class sits at ~0.5
+    # (measured: reaches 1.0 by step ~150).
+    assert metrics["accuracy"] > 0.9
 
 
 def test_hash_features_deterministic():

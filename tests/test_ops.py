@@ -102,6 +102,34 @@ def test_int8_decode_attention_matches_xla():
         )
 
 
+def test_int8_decode_attention_odd_cache_length():
+    # Non-power-of-two S must keep full-width tiles (padded trailing
+    # block), not collapse block_k to gcd(S, block) — and stay exact.
+    from tf_yarn_tpu.ops.attention import xla_attention
+    from tf_yarn_tpu.ops.decode_attention import int8_decode_attention
+    from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    B, S, H, Hkv, D = 1, 200, 4, 2, 64
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    kq, ks = quantize_int8(k)
+    vq, vs = quantize_int8(v)
+    k_deq = dequantize_int8(kq, ks, jnp.float32)
+    v_deq = dequantize_int8(vq, vs, jnp.float32)
+    for length in (1, 64, 130, 200):
+        out = int8_decode_attention(q, kq, ks, vq, vs, length, block_k=64)
+        ref = xla_attention(
+            q[:, None], k_deq[:, :length], v_deq[:, :length],
+            causal=True, segment_offset=length - 1,
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-4,
+            err_msg=f"length={length}",
+        )
+
+
 def test_int8_decode_attention_gqa_group_mapping():
     # Each q-head group must read ITS kv head: make kv heads wildly
     # different scales and check groups diverge accordingly.

@@ -329,7 +329,12 @@ class KVClient(KVStore):
                     # Must outlive the server-side wait (None = unbounded).
                     sock.settimeout(None if timeout is None else timeout + 5.0)
                 else:
-                    sock.settimeout(self._connect_timeout)
+                    # Mutations: connect was bounded above, but the reply
+                    # wait must be unbounded — a timeout mid-reply leaves
+                    # "was it applied?" unanswerable (the double-apply
+                    # hazard retries would have), e.g. a multi-MB put to a
+                    # briefly stalled server.
+                    sock.settimeout(None)
                 reply = self._roundtrip(sock, req)
             finally:
                 sock.close()
@@ -341,7 +346,10 @@ class KVClient(KVStore):
                         self._sock = socket.create_connection(
                             self._addr, timeout=self._connect_timeout
                         )
-                        self._sock.settimeout(self._connect_timeout)
+                        # Connect is bounded; reply waits are not (pre-
+                        # pooling semantics): reads must ride out a server
+                        # stalled mid-checkpoint rather than timing out.
+                        self._sock.settimeout(None)
                     try:
                         reply = self._roundtrip(self._sock, req)
                         break

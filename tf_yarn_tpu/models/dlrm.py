@@ -1,0 +1,188 @@
+"""DLRM — deep CTR model for the reference's Criteo-clicks domain.
+
+The reference's click-prediction surface is the linear estimator
+(reference: examples/linear_classifier_example.py:33-79, served by
+ParameterServerStrategy so the weight table can exceed one host); this is
+the deep extension of the same workload — categorical embeddings, a
+bottom MLP over dense features, pairwise feature interaction, and a top
+MLP — built TPU-first:
+
+* **One stacked embedding table.** All categorical tables concatenate
+  into a single ``[sum(table_sizes), embed_dim]`` param sharded over the
+  fsdp axis (the PS replacement, SURVEY.md §2.4): per-feature offsets are
+  baked in at trace time and one fused gather fetches every feature's
+  row. No per-table gathers, no parameter servers — lookups of remote
+  shards ride ICI collectives inserted by XLA.
+* **Interaction as one batched matmul.** Pairwise dots between feature
+  embeddings are ``einsum('bfd,bgd->bfg')`` — an MXU-shaped batched
+  matmul — with the static upper-triangle gathered afterwards, instead of
+  a scalar loop over pairs.
+* **bf16 compute, f32 params/loss**, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DLRMConfig:
+    # Criteo clicks: 26 categorical + 13 numeric features.
+    table_sizes: Tuple[int, ...] = (2**17,) * 26
+    embed_dim: int = 64
+    n_dense: int = 13
+    bottom_mlp: Tuple[int, ...] = (512, 256)
+    top_mlp: Tuple[int, ...] = (512, 256)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def total_buckets(self) -> int:
+        return sum(self.table_sizes)
+
+    @classmethod
+    def criteo(cls, **overrides) -> "DLRMConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "DLRMConfig":
+        defaults = dict(
+            table_sizes=(64,) * 4, embed_dim=8, n_dense=4,
+            bottom_mlp=(16,), top_mlp=(16,),
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class DLRM(nn.Module):
+    """{"cat": int32 [B, F] per-table ids, "dense": [B, n_dense]} -> logit [B, 1]."""
+
+    config: DLRMConfig
+
+    @nn.compact
+    def __call__(self, cat, dense=None, deterministic: bool = True):
+        # deterministic accepted for loss-contract uniformity (no dropout
+        # today; adding it to the MLPs is config-only because the loss
+        # already threads the rng/flag).
+        cfg = self.config
+        n_tables = len(cfg.table_sizes)
+        if cat.shape[-1] != n_tables:
+            raise ValueError(
+                f"cat has {cat.shape[-1]} features, config has {n_tables} tables"
+            )
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(
+                nn.initializers.normal(stddev=1.0 / np.sqrt(cfg.embed_dim)),
+                ("embed", None),
+            ),
+            (cfg.total_buckets, cfg.embed_dim),
+            cfg.param_dtype,
+        )
+        # Static per-table offsets into the stacked table; one gather total.
+        # Ids are folded into their own table's range first (hashed-feature
+        # semantics, same as linear.hash_features' mod-bucketing): without
+        # it an out-of-range id would silently land in a *neighboring*
+        # table's rows and train the wrong feature's embedding.
+        offsets = np.concatenate(
+            ([0], np.cumsum(cfg.table_sizes[:-1]))
+        ).astype(np.int32)
+        sizes = jnp.asarray(np.asarray(cfg.table_sizes, np.int32))
+        ids = cat % sizes[None, :] + jnp.asarray(offsets)[None, :]
+        emb = table[ids].astype(cfg.dtype)  # [B, F, D]
+
+        feats = emb
+        bottom = None
+        if dense is not None and cfg.n_dense:
+            x = dense.astype(cfg.dtype)
+            for index, width in enumerate(cfg.bottom_mlp + (cfg.embed_dim,)):
+                x = nn.Dense(width, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name=f"bottom{index}")(x)
+                x = nn.relu(x)
+            bottom = x
+            feats = jnp.concatenate([x[:, None, :], emb], axis=1)  # [B, F+1, D]
+
+        # Pairwise feature interaction on the MXU; strict upper triangle
+        # (self-dots excluded, symmetric pairs deduped) via static indices.
+        inter = jnp.einsum("bfd,bgd->bfg", feats, feats)
+        iu, ju = np.triu_indices(feats.shape[1], k=1)
+        pairs = inter[:, iu, ju]  # [B, n_pairs]
+
+        top = jnp.concatenate([bottom, pairs], -1) if bottom is not None else pairs
+        for index, width in enumerate(self.config.top_mlp):
+            top = nn.Dense(width, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           name=f"top{index}")(top)
+            top = nn.relu(top)
+        return nn.Dense(1, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                        name="head")(top)
+
+
+def dlrm_loss(model, params, batch, rng, train=True):
+    """Sigmoid cross-entropy over {"cat", "dense", "y"} batches (the
+    common.binary_logistic_loss contract, with DLRM's two feature
+    tensors)."""
+    import optax
+
+    logits = model.apply(
+        params, batch["cat"], batch.get("dense"),
+        rngs={"dropout": rng}, deterministic=not train,
+    ).squeeze(-1)
+    labels = batch["y"].astype(jnp.float32)
+    loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+    accuracy = jnp.mean((logits > 0) == (labels > 0.5))
+    return loss, {"accuracy": accuracy}
+
+
+def make_experiment(
+    config: Optional[DLRMConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 200,
+    batch_size: int = 1024,
+    learning_rate: float = 1e-3,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+
+    config = config or DLRMConfig.criteo()
+    model = DLRM(config)
+
+    def synthetic():
+        # Balanced, learnable labels: each bucket of table 0 carries a
+        # fixed ±1 vote (memorizable in its embedding row), so a working
+        # model separates the classes and a broken one sits at ~50% —
+        # unlike rare-positive CTR labels, where all-negative already
+        # scores >90% and hides breakage.
+        rng = np.random.RandomState(0)
+        n_tables = len(config.table_sizes)
+        sizes = np.asarray(config.table_sizes)
+        while True:
+            cat = rng.randint(0, sizes, (batch_size, n_tables)).astype(np.int32)
+            dense = rng.lognormal(0.0, 1.0, (batch_size, config.n_dense))
+            y = cat[:, 0] % 2
+            yield {
+                "cat": cat,
+                "dense": np.log1p(dense).astype(np.float32),
+                "y": y.astype(np.int32),
+            }
+
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.adagrad(learning_rate),
+        loss_fn=dlrm_loss,
+        train_input_fn=input_fn or synthetic,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: model.init(rng, batch["cat"], batch.get("dense")),
+        mesh_spec=mesh_spec,
+    )

@@ -11,6 +11,9 @@ H_kv <= H — selected by `TransformerConfig.attention_impl`:
 * ``"ring"``  — sequence-parallel ring attention over the `sp` mesh axis
   (tf_yarn_tpu/parallel/ring_attention.py) for sequences longer than one
   chip's HBM can hold.
+* ``"ulysses"`` — all-to-all sequence parallelism over `sp`
+  (tf_yarn_tpu/parallel/ulysses.py): re-shard seq->heads, full-sequence
+  attention per head shard, re-shard back.
 """
 
 from __future__ import annotations
@@ -67,6 +70,12 @@ def attention(query, key, value, *, impl: str = "xla", causal: bool = True):
         from tf_yarn_tpu.parallel.ring_attention import ring_attention_sharded
 
         return ring_attention_sharded(query, key, value, causal=causal)
+    if impl == "ulysses":
+        from tf_yarn_tpu.parallel.ulysses import ulysses_attention_sharded
+
+        return ulysses_attention_sharded(query, key, value, causal=causal)
     if impl != "xla":
-        raise ValueError(f"unknown attention impl {impl!r}; use xla | flash | ring")
+        raise ValueError(
+            f"unknown attention impl {impl!r}; use xla | flash | ring | ulysses"
+        )
     return xla_attention(query, key, value, causal=causal)

@@ -67,9 +67,16 @@ def _decode_kernel(length_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
             v_blk = v_ref[0, :, h * head_dim:(h + 1) * head_dim]
             scale_k = ks_ref[0, :, h:h + 1]  # (block_k, 1) f32
             scale_v = vs_ref[0, :, h:h + 1]
-            # Dequant in VMEM: int8 -> f32 rows * per-row scale.
+            # Dequant in VMEM: int8 -> f32 rows * per-row scale. Dead rows
+            # (past `length` or in the padded trailing block) must be
+            # zeroed in v, not just masked in the logits: p is 0 there but
+            # pad garbage in the f32 scales can be NaN, and 0 * NaN = NaN
+            # in the p @ v accumulation.
+            live_col = live_row[0][:, None]  # (block_k, 1)
             k_f = k_blk.astype(jnp.float32) * scale_k
-            v_f = v_blk.astype(jnp.float32) * scale_v
+            v_f = jnp.where(
+                live_col, v_blk.astype(jnp.float32) * scale_v, 0.0
+            )
             q_h = q_ref[0, h * group:(h + 1) * group, :].astype(jnp.float32)
             logits = lax.dot_general(
                 q_h * softmax_scale, k_f, (((1,), (1,)), ((), ())),
@@ -115,14 +122,14 @@ def int8_decode_attention(
     [B, H, D] attention output in `query`'s dtype."""
     from jax.experimental.pallas import tpu as pltpu
 
-    import math
-
     b, n_heads, head_dim = query.shape
     _, s, n_kv, _ = key_q.shape
     group = n_heads // n_kv
-    # Fold to a divisor of the cache length (e.g. S=768 -> 256) instead of
-    # raising: any S the cache can hold must decode.
-    block_k = math.gcd(s, min(block_k, s))
+    # Any S the cache can hold must decode at full tile width: the grid
+    # rounds up and pallas pads the trailing partial block (dead positions
+    # are masked in-kernel), so an odd S never collapses block_k.
+    block_k = min(block_k, s)
+    num_kb = -(-s // block_k)
     if softmax_scale is None:
         softmax_scale = head_dim**-0.5
     if interpret is None:
@@ -140,7 +147,7 @@ def int8_decode_attention(
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, s // block_k),
+        grid=(b, num_kb),
         in_specs=[
             pl.BlockSpec((1, n_heads, head_dim), lambda bi, ki, length: (bi, 0, 0)),
             pl.BlockSpec((1, block_k, n_kv * head_dim),

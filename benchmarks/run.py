@@ -132,6 +132,32 @@ def bench_resnet50(tpu: bool):
     )
 
 
+def bench_vit_base(tpu: bool):
+    """ViT-B/16 on 224px images — encoder-stack vision throughput
+    (transformer-native counterpart of the resnet50 config)."""
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.benchmark import measure_throughput
+    from tf_yarn_tpu.models import common, vit
+
+    config = vit.ViTConfig.base16() if tpu else vit.ViTConfig.tiny()
+    batch = 128 if tpu else 8
+    size = config.image_size
+    rng = np.random.RandomState(0)
+    model = vit.ViT(config)
+    return measure_throughput(
+        model,
+        common.classification_loss,
+        optax.adamw(3e-4),
+        {
+            "x": rng.randn(batch, size, size, 3).astype(np.float32),
+            "y": rng.randint(0, config.num_classes, batch).astype(np.int32),
+        },
+        steps=10 if tpu else 5,
+    )
+
+
 def bench_llama_lora(tpu: bool):
     import numpy as np
 
@@ -245,6 +271,7 @@ CONFIGS = {
     "bert_base": bench_bert_base,
     "dlrm_clicks": bench_dlrm_clicks,
     "resnet50": bench_resnet50,
+    "vit_base": bench_vit_base,
     "llama_lora": bench_llama_lora,
     "long_context": bench_long_context,
     "ici_allreduce": bench_ici_allreduce,

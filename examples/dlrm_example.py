@@ -1,0 +1,45 @@
+"""Deep CTR (DLRM) on the Criteo-clicks shape — the deep sibling of
+linear_classifier_example.py (reference analog:
+examples/linear_classifier_example.py, whose LinearClassifier is the
+shallow version of this workload).
+
+Shows the stacked mesh-sharded embedding: 8 categorical tables live in
+one fsdp-sharded param, dense features feed a bottom MLP, and pairwise
+feature interaction runs as a single batched matmul.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("TPU_YARN_VIRTUAL_DEVICES", "8")
+os.environ.setdefault("TPU_YARN_PLATFORM", os.environ.get("EXAMPLE_PLATFORM", "cpu"))
+
+
+def experiment_fn():
+    from tf_yarn_tpu.models import dlrm
+    from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+    config = dlrm.DLRMConfig(
+        table_sizes=(4096,) * 8,
+        embed_dim=32,
+        n_dense=8,
+        bottom_mlp=(128,),
+        top_mlp=(128,),
+    )
+    return dlrm.make_experiment(
+        config,
+        train_steps=120,
+        batch_size=512,
+        learning_rate=0.1,
+        mesh_spec=MeshSpec(dp=2, fsdp=4),
+    )
+
+
+if __name__ == "__main__":
+    from tf_yarn_tpu import TaskSpec, run_on_tpu
+
+    metrics = run_on_tpu(
+        experiment_fn, {"worker": TaskSpec(instances=1)}, name="dlrm"
+    )
+    print("run metrics:", metrics)

@@ -10,6 +10,8 @@ for example in \
     distributed_fn_example \
     mnist_keras_example \
     linear_classifier_example \
+    dlrm_example \
+    mlflow_example \
     collective_allreduce_example \
     llama_lora_example \
     pytorch_example \

@@ -169,6 +169,21 @@ def test_ulysses_mixed_mesh_gqa_expands_kv():
         mesh_lib.set_current_mesh(None)
 
 
+def test_ulysses_flash_inner_matches_xla():
+    # attention_impl="ulysses_flash": the pallas kernel (interpret mode on
+    # CPU) runs inside each head shard after the all_to_all.
+    devices = select_devices(8, platform="cpu")
+    mesh = build_mesh(MeshSpec(dp=2, sp=4), devices)
+    mesh_lib.set_current_mesh(mesh)
+    try:
+        q, k, v = _qkv(b=2, s=128, h=4, d=16)
+        ref = xla_attention(q, k, v, causal=True)
+        out = attention(q, k, v, impl="ulysses_flash", causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    finally:
+        mesh_lib.set_current_mesh(None)
+
+
 def test_ulysses_no_mesh_falls_back():
     from tf_yarn_tpu.parallel.ulysses import ulysses_attention_sharded
 

@@ -276,6 +276,36 @@ def test_dlrm_trains_sharded():
     assert metrics["accuracy"] > 0.9
 
 
+def test_vit_forward_and_train():
+    from tf_yarn_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny()
+    model = vit.ViT(cfg)
+    images = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), images)
+    logits = model.apply(variables, images)
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+    # CLS + 16 patches of 8x8 on a 32px image.
+    assert variables["params"]["position_embedding"].value.shape[0] == 17
+
+    exp = vit.make_experiment(
+        cfg, train_steps=4, batch_size=8,
+        mesh_spec=MeshSpec(dp=4, tp=2),
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
+    assert np.isfinite(metrics["loss"])
+
+
+def test_vit_rejects_wrong_image_size():
+    from tf_yarn_tpu.models import vit
+
+    cfg = vit.ViTConfig.tiny()
+    model = vit.ViT(cfg)
+    with pytest.raises(ValueError, match="32x32"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+
+
 def test_hash_features_deterministic():
     rows = [["a", "b"], ["a", "c"]]
     h1 = linear.hash_features(rows, 128)

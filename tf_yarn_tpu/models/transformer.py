@@ -44,7 +44,7 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "xla"  # xla | flash | ring | ulysses
+    attention_impl: str = "xla"  # xla | flash | ring | ulysses | ulysses_flash
     scan_layers: bool = True
     remat: bool = True
     lora_rank: int = 0

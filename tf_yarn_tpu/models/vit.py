@@ -1,0 +1,148 @@
+"""Vision Transformer — image classification on the encoder stack.
+
+Extends the zoo beyond the reference's vision surface (its image path is
+the opaque torch CNN of examples/pytorch/pytorch_example.py; ResNet
+covers that here) with the transformer-native alternative: patchify via
+one conv (stride = patch size — an MXU matmul per patch, no im2col), a
+CLS token + learned position embeddings, and the *same* EncoderBlock as
+BERT (models/bert.py) — one encoder implementation for both modalities,
+so megatron logical names, TP/FSDP placement, LoRA, and the attention
+dispatcher all apply unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tf_yarn_tpu.models.bert import EncoderBlock, _Dense
+from tf_yarn_tpu.models.transformer import EMBED, _partitioned
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    # Duck-compatible with BertConfig for EncoderBlock/_Dense reuse.
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dropout_rate: float = 0.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def base16(cls, **overrides) -> "ViTConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "ViTConfig":
+        defaults = dict(
+            image_size=32, patch_size=8, num_classes=10, d_model=32,
+            n_layers=2, n_heads=2, d_ff=64,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+class ViT(nn.Module):
+    """images [B, H, W, C] -> logits [B, num_classes]."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        if x.shape[1] != cfg.image_size or x.shape[2] != cfg.image_size:
+            raise ValueError(
+                f"expected {cfg.image_size}x{cfg.image_size} images, "
+                f"got {x.shape[1]}x{x.shape[2]}"
+            )
+        p = cfg.patch_size
+        x = nn.Conv(
+            cfg.d_model, (p, p), strides=(p, p), padding="VALID",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="patchify",
+        )(x.astype(cfg.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, cfg.n_patches, cfg.d_model)
+
+        cls_tok = self.param(
+            "cls_token", nn.initializers.zeros_init(),
+            (1, 1, cfg.d_model), cfg.param_dtype,
+        )
+        pos_emb = self.param(
+            "position_embedding",
+            _partitioned((None, EMBED))(nn.initializers.normal(stddev=0.02)),
+            (cfg.n_patches + 1, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls_tok.astype(cfg.dtype), (b, 1, cfg.d_model)), x],
+            axis=1,
+        )
+        x = x + pos_emb.astype(cfg.dtype)[None]
+        x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
+        logits = _Dense(cfg.num_classes, (EMBED, None), cfg, name="head")(x[:, 0])
+        return logits.astype(jnp.float32)
+
+
+def make_experiment(
+    config: Optional[ViTConfig] = None,
+    model_dir: Optional[str] = None,
+    train_steps: int = 100,
+    batch_size: int = 128,
+    learning_rate: float = 3e-4,
+    mesh_spec=None,
+    input_fn=None,
+    **train_param_overrides,
+):
+    import numpy as np
+    import optax
+
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+    from tf_yarn_tpu.models import common
+
+    config = config or ViTConfig.base16()
+    model = ViT(config)
+
+    def synthetic():
+        rng = np.random.RandomState(0)
+        size = config.image_size
+        while True:
+            yield {
+                "x": rng.randn(batch_size, size, size, 3).astype(np.float32),
+                "y": rng.randint(0, config.num_classes, batch_size).astype(np.int32),
+            }
+
+    defaults = dict(train_steps=train_steps, log_every_steps=max(1, train_steps // 10))
+    defaults.update(train_param_overrides)
+    return JaxExperiment(
+        model=model,
+        optimizer=optax.adamw(learning_rate, weight_decay=0.05),
+        loss_fn=common.classification_loss,
+        train_input_fn=input_fn or synthetic,
+        train_params=TrainParams(**defaults),
+        model_dir=model_dir,
+        init_fn=lambda rng, batch: model.init(rng, batch["x"]),
+        mesh_spec=mesh_spec,
+    )

@@ -13,7 +13,8 @@ H_kv <= H — selected by `TransformerConfig.attention_impl`:
   chip's HBM can hold.
 * ``"ulysses"`` — all-to-all sequence parallelism over `sp`
   (tf_yarn_tpu/parallel/ulysses.py): re-shard seq->heads, full-sequence
-  attention per head shard, re-shard back.
+  attention per head shard, re-shard back. ``"ulysses_flash"`` runs the
+  pallas flash kernel as the per-shard inner attention.
 """
 
 from __future__ import annotations
@@ -70,12 +71,16 @@ def attention(query, key, value, *, impl: str = "xla", causal: bool = True):
         from tf_yarn_tpu.parallel.ring_attention import ring_attention_sharded
 
         return ring_attention_sharded(query, key, value, causal=causal)
-    if impl == "ulysses":
+    if impl in ("ulysses", "ulysses_flash"):
         from tf_yarn_tpu.parallel.ulysses import ulysses_attention_sharded
 
-        return ulysses_attention_sharded(query, key, value, causal=causal)
+        return ulysses_attention_sharded(
+            query, key, value, causal=causal,
+            inner="flash" if impl == "ulysses_flash" else "xla",
+        )
     if impl != "xla":
         raise ValueError(
-            f"unknown attention impl {impl!r}; use xla | flash | ring | ulysses"
+            f"unknown attention impl {impl!r}; "
+            "use xla | flash | ring | ulysses | ulysses_flash"
         )
     return xla_attention(query, key, value, causal=causal)

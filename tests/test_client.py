@@ -101,6 +101,53 @@ def test_run_on_tpu_retry_then_success(tmp_path):
     assert metrics is not None
 
 
+def test_run_on_tpu_sigkilled_task_fails_run_then_retry_recovers(tmp_path):
+    # Preemption semantics: a SIGKILLed task emits NO stop event — the
+    # driver must detect the dead process via backend status (not hang
+    # waiting on events), fail the attempt, and a retry must recover.
+    import signal
+
+    marker = str(tmp_path / "killed-once")
+    out = str(tmp_path / "done")
+
+    def experiment_fn():
+        def run(params):
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+            open(out, "w").close()
+
+        return run
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        _worker_specs(instances=1),
+        custom_task_module=DISTRIBUTED,
+        nb_retries=1,
+        poll_every_secs=0.2,
+    )
+    assert os.path.exists(out)
+    assert metrics is not None
+
+
+def test_run_on_tpu_sigkilled_task_no_retries_raises(tmp_path):
+    import signal
+
+    def experiment_fn():
+        def run(params):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        return run
+
+    with pytest.raises(RunFailed):
+        run_on_tpu(
+            experiment_fn,
+            _worker_specs(instances=1),
+            custom_task_module=DISTRIBUTED,
+            poll_every_secs=0.2,
+        )
+
+
 def test_run_on_tpu_ships_files_into_task_cwd(tmp_path):
     payload = tmp_path / "config.json"
     payload.write_text('{"lr": 0.1}')

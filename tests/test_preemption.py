@@ -1,0 +1,167 @@
+"""Preemption-aware shutdown: SIGTERM -> flag -> checkpoint -> Preempted
+-> retry resumes (tf_yarn_tpu/preemption.py). The reference has no analog
+(YARN containers die unwarned); on TPU VMs the SIGTERM grace window is a
+first-class lifecycle event."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+from tf_yarn_tpu import preemption
+
+
+@pytest.fixture(autouse=True)
+def _clean_flag():
+    preemption.reset()
+    yield
+    preemption.reset()
+
+
+def test_sigterm_sets_flag_without_dying():
+    assert preemption.install()
+    assert not preemption.requested()
+    os.kill(os.getpid(), signal.SIGTERM)
+    # Signal delivery is synchronous for self-kill on the main thread.
+    assert preemption.requested()
+    # Restore pytest's default handler.
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+def test_second_sigterm_abandons_drain_and_dies():
+    # Escalating killers (driver kill paths, double Ctrl-C) must still
+    # terminate: the first TERM sets the flag, the second restores the
+    # default disposition and re-delivers.
+    import subprocess
+    import sys
+
+    script = (
+        "import signal\n"
+        "from tf_yarn_tpu import preemption\n"
+        "preemption.install()\n"
+        "signal.raise_signal(signal.SIGTERM)\n"
+        "assert preemption.requested()\n"
+        "signal.raise_signal(signal.SIGTERM)\n"
+        "print('UNREACHABLE')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": "/root/repo"}, text=True,
+    )
+    assert proc.returncode == -signal.SIGTERM, (proc.returncode, proc.stderr)
+    assert "UNREACHABLE" not in proc.stdout
+
+
+def test_flag_during_final_step_completes_normally(tmp_path):
+    # A SIGTERM landing as training finishes must not fail a done run.
+    from tf_yarn_tpu.experiment import as_core_experiment
+    from tf_yarn_tpu.models import transformer
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.training import train_and_evaluate
+
+    cfg = transformer.TransformerConfig.tiny()
+    exp = transformer.make_experiment(
+        cfg, train_steps=1, batch_size=8, seq_len=32,
+        model_dir=str(tmp_path / "model"),
+    )
+    preemption.request()  # flag already up when the only step completes
+    metrics = train_and_evaluate(
+        as_core_experiment(exp), devices=select_devices(8, platform="cpu")
+    )
+    assert np.isfinite(metrics["loss"])
+    assert ckpt_lib.list_checkpoint_steps(str(tmp_path / "model"))[-1] == 1
+
+
+def test_train_loop_drains_saves_and_resumes(tmp_path):
+    from tf_yarn_tpu.experiment import as_core_experiment
+    from tf_yarn_tpu.models import transformer
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.training import train_and_evaluate
+
+    model_dir = str(tmp_path / "model")
+    cfg = transformer.TransformerConfig.tiny()
+    devices = select_devices(8, platform="cpu")
+
+    def preempting_input():
+        rng = np.random.RandomState(0)
+        n = 0
+        while True:
+            n += 1
+            if n == 4:  # mid-run, ahead of the prefetch depth
+                preemption.request()
+            yield {
+                "tokens": rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+            }
+
+    exp = transformer.make_experiment(
+        cfg, train_steps=50, batch_size=8, seq_len=32, model_dir=model_dir,
+        input_fn=preempting_input,
+    )
+    with pytest.raises(preemption.Preempted, match="checkpoint saved"):
+        train_and_evaluate(as_core_experiment(exp), devices=devices)
+
+    steps = ckpt_lib.list_checkpoint_steps(model_dir)
+    assert steps, "preemption drain must leave a checkpoint"
+    drained_at = steps[-1]
+    assert 0 < drained_at < 50
+
+    # Second attempt (fresh process in real runs): resumes past the drain
+    # step and completes.
+    preemption.reset()
+    exp2 = transformer.make_experiment(
+        cfg, train_steps=drained_at + 4, batch_size=8, seq_len=32,
+        model_dir=model_dir,
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp2), devices=devices)
+    assert np.isfinite(metrics["loss"])
+    assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == drained_at + 4
+
+
+def test_launcher_retry_recovers_from_preemption(tmp_path):
+    # Full path: Preempted ships through the stop event, the driver's
+    # nb_retries relaunch resumes from the saved checkpoint.
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    model_dir = str(tmp_path / "model")
+    marker = str(tmp_path / "preempted-once")
+
+    def experiment_fn():
+        import numpy as np
+
+        from tf_yarn_tpu import preemption as preemption_mod
+        from tf_yarn_tpu.models import transformer
+
+        cfg = transformer.TransformerConfig.tiny()
+
+        def input_fn():
+            rng = np.random.RandomState(0)
+            n = 0
+            while True:
+                n += 1
+                if n == 4 and not os.path.exists(marker):
+                    open(marker, "w").close()
+                    preemption_mod.request()
+                yield {
+                    "tokens": rng.randint(
+                        0, cfg.vocab_size, (8, 32)
+                    ).astype(np.int32)
+                }
+
+        return transformer.make_experiment(
+            cfg, train_steps=12, batch_size=8, seq_len=32,
+            model_dir=model_dir, input_fn=input_fn,
+        )
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=1)},
+        env={"TPU_YARN_PLATFORM": "cpu", "TPU_YARN_VIRTUAL_DEVICES": "8"},
+        nb_retries=1,
+        poll_every_secs=0.2,
+    )
+    assert os.path.exists(marker)
+    assert metrics.total_training_duration is not None
+    assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == 12

@@ -1,0 +1,76 @@
+"""Preemption-aware shutdown: SIGTERM -> checkpoint -> retryable failure.
+
+TPU VMs (spot/preemptible, maintenance events) get a SIGTERM grace window
+before the host disappears — a lifecycle the reference never had to
+handle (YARN containers are simply killed; reference client.py's retry
+loop restarts the whole app from the last Estimator checkpoint). Here the
+window is used: the task program installs a handler (main thread, before
+the train thread starts), the train loop polls the flag at its host
+boundaries, saves a checkpoint, and raises :class:`Preempted` — which
+ships through the stop event like any failure, so the driver's
+`nb_retries` loop relaunches and the next attempt resumes from that
+checkpoint instead of losing the window's progress.
+
+User train loops (PyTorch `main_fn`, generic distributed fns) can poll
+:func:`requested` themselves for the same behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Iterable, Optional
+
+_logger = logging.getLogger(__name__)
+
+_requested = threading.Event()
+
+
+class Preempted(RuntimeError):
+    """Raised by the train loop after a preemption-triggered save; marks
+    the attempt failed-but-resumable (driver retries resume from the
+    checkpoint the handler just wrote)."""
+
+
+def install(signals: Optional[Iterable[int]] = None) -> bool:
+    """Install the preemption handler. Main-thread only (CPython restricts
+    signal.signal); returns False (and logs) elsewhere so task programs
+    can call it unconditionally."""
+    if threading.current_thread() is not threading.main_thread():
+        _logger.warning("preemption.install skipped: not on the main thread")
+        return False
+    for sig in signals or (signal.SIGTERM,):
+        signal.signal(sig, _handle)
+    return True
+
+
+def _handle(signum, frame) -> None:
+    if _requested.is_set():
+        # Second signal = the sender escalating (driver kill paths send
+        # TERM then KILL after a bound; an impatient operator hits Ctrl-C
+        # twice): stop draining, die with the default disposition now —
+        # a worker wedged in a collective never reaches the drain poll
+        # and must not outlive the kill.
+        _logger.warning("signal %d again: abandoning drain, exiting", signum)
+        signal.signal(signum, signal.SIG_DFL)
+        signal.raise_signal(signum)
+        return
+    _logger.warning("signal %d received: preemption drain requested", signum)
+    _requested.set()
+
+
+def request() -> None:
+    """Set the flag programmatically (tests; cloud notice pollers that
+    learn of preemption out-of-band, e.g. the GCE metadata server)."""
+    _requested.set()
+
+
+def requested() -> bool:
+    return _requested.is_set()
+
+
+def reset() -> None:
+    """Clear the flag (between run_on_tpu attempts in one process, and in
+    tests)."""
+    _requested.clear()

@@ -41,6 +41,11 @@ class TaskParameters(NamedTuple):
 
 def _child_main(fn_bytes: bytes, params: TaskParameters, error_queue) -> None:
     try:
+        from tf_yarn_tpu import preemption
+
+        # Fresh interpreter (spawn): the flag/handler don't inherit — user
+        # fns polling preemption.requested() need the install here.
+        preemption.install()
         fn = cloudpickle.loads(fn_bytes)
         fn(params)
     except BaseException as exc:  # noqa: B036 — ship to parent
@@ -75,6 +80,9 @@ def parallel_run(fn_bytes: bytes, params_list: List[TaskParameters]) -> None:
 
 
 def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    preemption.install()  # SIGTERM -> drain flag for fns that poll it
     runtime = _bootstrap.init_runtime()
     with _bootstrap.reporting_shutdown(runtime):
         master_addr = _task_commons.choose_master(

@@ -164,6 +164,11 @@ def _upload_tb_logs(local_dir: str, remote_dir: str) -> None:
 
 
 def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    # SIGTERM -> drain flag; user main_fn polls preemption.requested().
+    # (nb_proc>1 children get their own install in distributed._child_main.)
+    preemption.install()
     runtime = _bootstrap.init_runtime()
     with _bootstrap.reporting_shutdown(runtime):
         experiment = _task_commons.get_experiment(runtime.kv)

@@ -91,6 +91,11 @@ def _run_experiment(runtime: _bootstrap.TaskRuntime, experiment) -> None:
 
 
 def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    # Main thread, before the train thread exists: SIGTERM (the TPU-VM
+    # preemption notice) sets the drain flag the train loop polls.
+    preemption.install()
     runtime = _bootstrap.init_runtime()
     with _bootstrap.reporting_shutdown(runtime):
         experiment = _task_commons.get_experiment(runtime.kv)

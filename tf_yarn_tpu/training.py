@@ -34,7 +34,7 @@ import numpy as np
 import optax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
-from tf_yarn_tpu import event
+from tf_yarn_tpu import event, preemption
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
@@ -534,6 +534,30 @@ def train_and_evaluate(
                 if not ran_chunk:
                     state, metrics = run_single(state, batch)
                     step += 1
+                if preemption.requested() and step < params_cfg.train_steps:
+                    # First thing at the host boundary — before eval/log
+                    # work that could outlive the SIGTERM grace window.
+                    # A flag raised during the final step falls through to
+                    # normal completion instead (the run IS done; failing
+                    # it would burn a relaunch to restore a finished
+                    # checkpoint). SIGTERM grace window (TPU-VM
+                    # preemption): persist progress, then fail the attempt
+                    # as retryable — the driver's nb_retries relaunch
+                    # resumes from this step.
+                    _logger.warning(
+                        "preemption drain at step %d: saving checkpoint", step
+                    )
+                    if core.model_dir:
+                        ckpt_writer.save(core.model_dir, step, state)
+                        ckpt_writer.wait()
+                    raise preemption.Preempted(
+                        f"preempted at step {step}"
+                        + (
+                            f"; checkpoint saved to {core.model_dir}"
+                            if core.model_dir
+                            else " (no model_dir: progress lost)"
+                        )
+                    )
                 if (
                     step % params_cfg.log_every_steps == 0
                     or step == params_cfg.train_steps

@@ -53,6 +53,70 @@ def test_chief_plus_worker_multihost(tmp_path):
     assert open(f"{out}-1").read() == "worker:0"
 
 
+def test_preemption_drain_agreed_across_hosts(tmp_path):
+    """One host's SIGTERM flag must become BOTH hosts' drain decision
+    (skewed delivery would otherwise deadlock the multi-host checkpoint
+    save), and the retry resumes from the drain checkpoint."""
+    import os
+
+    model_dir = str(tmp_path / "model")
+    marker = str(tmp_path / "preempted-once")
+
+    def experiment_fn():
+        import optax
+
+        from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+        from tf_yarn_tpu.models import common, mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        def input_fn(start_step=0):
+            import os
+
+            import jax
+
+            from tf_yarn_tpu import preemption
+
+            def gen():
+                base = common.synthetic_classification_iter(4, 16, 4)
+                n = 0
+                for batch in base:
+                    n += 1
+                    # Only process 1 ever sees the "signal", once.
+                    if (
+                        n == 3
+                        and jax.process_index() == 1
+                        and not os.path.exists(marker)
+                    ):
+                        open(marker, "w").close()
+                        preemption.request()
+                    yield batch
+
+            return gen()
+
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=input_fn,
+            train_params=TrainParams(train_steps=10, log_every_steps=2),
+            mesh_spec=MeshSpec(dp=2),
+            model_dir=model_dir,
+        )
+
+    metrics = run_on_tpu(
+        experiment_fn,
+        {"chief": TaskSpec(instances=1), "worker": TaskSpec(instances=1)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        nb_retries=1,
+        poll_every_secs=0.3,
+    )
+    from tf_yarn_tpu import checkpoint as ckpt_lib
+
+    assert os.path.exists(marker), "preemption never injected"
+    assert metrics.total_training_duration is not None
+    assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == 10
+
+
 def test_two_process_data_parallel_training(tmp_path):
     out = str(tmp_path / "world")
 

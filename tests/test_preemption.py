@@ -46,9 +46,10 @@ def test_second_sigterm_abandons_drain_and_dies():
         "signal.raise_signal(signal.SIGTERM)\n"
         "print('UNREACHABLE')\n"
     )
+    # conftest already exports the repo root on PYTHONPATH for subprocesses.
     proc = subprocess.run(
         [sys.executable, "-c", script], capture_output=True, timeout=60,
-        env={**os.environ, "PYTHONPATH": "/root/repo"}, text=True,
+        text=True,
     )
     assert proc.returncode == -signal.SIGTERM, (proc.returncode, proc.stderr)
     assert "UNREACHABLE" not in proc.stdout

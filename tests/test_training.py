@@ -87,6 +87,39 @@ def test_checkpoint_and_resume(tmp_path):
     assert ckpt_lib.latest_checkpoint_step(str(tmp_path)) == 25
 
 
+def test_input_fn_start_step_receives_resume_point(tmp_path):
+    # Input resume seam: an input_fn declaring `start_step` is told where
+    # training resumes so it can skip consumed data; one without the
+    # parameter keeps working (restart from the beginning).
+    import json
+
+    from tf_yarn_tpu.models import mnist as mnist_mod
+
+    record = str(tmp_path / "starts.jsonl")
+
+    def make_input(train_steps):
+        def input_fn(start_step=0):
+            with open(record, "a") as fh:
+                fh.write(json.dumps(start_step) + "\n")
+            return mnist_mod.common.synthetic_classification_iter(64, 32, 4)
+
+        return input_fn
+
+    devices = select_devices(8, platform="cpu")
+    core = _mnist_core(
+        tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=10,
+        input_fn=make_input(10),
+    )
+    train_and_evaluate(core, devices=devices)
+    core2 = _mnist_core(
+        tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=14,
+        input_fn=make_input(14),
+    )
+    train_and_evaluate(core2, devices=devices)
+    starts = [json.loads(line) for line in open(record)]
+    assert starts == [0, 10]
+
+
 def test_eval_loop(tmp_path):
     core = _mnist_core(
         mesh_spec=MeshSpec(fsdp=8),

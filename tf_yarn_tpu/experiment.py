@@ -29,6 +29,10 @@ from tf_yarn_tpu.parallel.mesh import MeshSpec
 
 Batch = Dict[str, Any]
 LossFn = Callable[..., Any]  # (model, params, batch, rng) -> (loss, aux)
+# Zero-arg factory of batch iterators. A train input_fn may also declare
+# a `start_step` keyword: on checkpoint resume the train loop passes the
+# resume step so the pipeline can skip already-consumed data (opt-in
+# input resume; see training._make_input_iter).
 InputFn = Callable[[], Iterator[Batch]]
 
 

@@ -266,6 +266,46 @@ class _StepsPerSecondHook:
             )
 
 
+def _preempt_agreed() -> bool:
+    """Whether ALL hosts should drain now. SIGTERM delivery is per-host
+    and skewed; a host draining alone would start a multi-host checkpoint
+    save (a collective) its peers never join — deadlock until the grace
+    window's SIGKILL. Every host calls this at every host boundary (the
+    SPMD loop keeps boundaries in lockstep), so the allgather is safe and
+    the max makes one host's flag everyone's decision."""
+    import jax
+
+    if jax.process_count() == 1:
+        return preemption.requested()
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.int32(preemption.requested())
+    )
+    return bool(np.max(flags))
+
+
+def _make_input_iter(input_fn, start_step: int, logger):
+    """Build the train iterator, passing `start_step` to input_fns that
+    declare it (opt-in input resume — the role tf.data checkpointing
+    plays for the reference's Estimator input_fns)."""
+    import inspect
+
+    try:
+        accepts = "start_step" in inspect.signature(input_fn).parameters
+    except (TypeError, ValueError):
+        accepts = False
+    if accepts:
+        return iter(input_fn(start_step=start_step))
+    if start_step:
+        logger.info(
+            "input_fn takes no start_step: input restarts from the "
+            "beginning at resume step %d (declare start_step to skip "
+            "already-consumed data)", start_step,
+        )
+    return iter(input_fn())
+
+
 def _make_tb_writer(model_dir: Optional[str]):
     if not model_dir:
         return None
@@ -300,7 +340,18 @@ def train_and_evaluate(
         mesh.devices.size,
     )
 
-    train_iter = core.train_input_fn()
+    # Resume-aware input: discover the resume step BEFORE building the
+    # iterator, and hand it to input_fns that opt in with a `start_step`
+    # parameter so they can skip already-consumed data (the tf.data-
+    # checkpoint role; the state restore itself happens under the mesh
+    # below). Input_fns without the parameter restart from the beginning —
+    # correct for stateless/synthetic streams, logged for the rest.
+    input_resume_step = 0
+    if core.model_dir:
+        input_resume_step = ckpt_lib.latest_checkpoint_step(core.model_dir) or 0
+    train_iter = _make_input_iter(
+        core.train_input_fn, input_resume_step, _logger
+    )
     first_batch = next(train_iter)
     init_fn = core.init_fn or _default_init_fn(core.model)
     rng = jax.random.PRNGKey(params_cfg.seed)
@@ -534,7 +585,11 @@ def train_and_evaluate(
                 if not ran_chunk:
                     state, metrics = run_single(state, batch)
                     step += 1
-                if preemption.requested() and step < params_cfg.train_steps:
+                if (
+                    _preempt_agreed()
+                    and not input_exhausted
+                    and step < params_cfg.train_steps
+                ):
                     # First thing at the host boundary — before eval/log
                     # work that could outlive the SIGTERM grace window.
                     # A flag raised during the final step falls through to

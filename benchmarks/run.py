@@ -1,9 +1,11 @@
 """BASELINE.json benchmark suite: one JSON line per config.
 
 The five configs BASELINE.md tracks (Keras-MNIST-dense, LinearClassifier
-clicks, BERT-base, ResNet-50, Llama-LoRA) plus the ICI allreduce
-microbench. Sizes are TPU-realistic when a TPU is present and tiny on the
-CPU rig (`--cpu` forces the latter).
+clicks, BERT-base, ResNet-50, Llama-LoRA) plus the additions this repo
+measures beyond them: dlrm_clicks, vit_base, long_context, decode (bf16
+vs int8 KV cache), and the ICI allreduce microbench. Sizes are
+TPU-realistic when a TPU is present and tiny on the CPU rig (`--cpu`
+forces the latter).
 
     python benchmarks/run.py                 # all configs
     python benchmarks/run.py bert_base       # one config
@@ -256,6 +258,88 @@ def bench_long_context(tpu: bool):
     return stats
 
 
+def bench_decode(tpu: bool):
+    """Autoregressive decode throughput (tokens/sec), bf16 vs int8 KV
+    cache. Decode steps are scanned inside ONE jitted program — per-step
+    host dispatch (~5ms through a relay) would otherwise dominate the
+    ~ms-scale decode step and measure the wrong thing."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    # Narrows the backend per TPU_YARN_PLATFORM (on the CPU rig the
+    # default backend would dial the TPU relay and hang).
+    select_devices()
+
+    results = {}
+    for cache_dtype in ("bf16", "int8"):
+        if tpu:
+            config = TransformerConfig(
+                vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+                n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+                scan_layers=False, kv_cache_dtype=cache_dtype,
+            )
+            batch, prefill_len, decode_tokens = 8, 128, 256
+        else:
+            config = TransformerConfig.tiny(kv_cache_dtype=cache_dtype,
+                                            scan_layers=False)
+            batch, prefill_len, decode_tokens = 2, 8, 16
+        model = Transformer(config)
+        rng = np.random.RandomState(0)
+        prompt = jnp.asarray(
+            rng.randint(0, config.vocab_size, (batch, prefill_len)), jnp.int32
+        )
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), prompt)
+
+        def prefill(params, prompt):
+            logits, state = model.apply(
+                params, prompt, decode=True, mutable=["cache"]
+            )
+            return state["cache"], jnp.argmax(
+                logits[:, -1], axis=-1
+            ).astype(jnp.int32)
+
+        def decode_n(params, cache, token):
+            def body(carry, _):
+                cache, token = carry
+                logits, state = model.apply(
+                    {**params, "cache": cache}, token[:, None], decode=True,
+                    mutable=["cache"],
+                )
+                return (
+                    state["cache"],
+                    jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32),
+                ), ()
+            (cache, token), _ = jax.lax.scan(
+                body, (cache, token), None, length=decode_tokens
+            )
+            return token
+
+        cache, token = jax.jit(prefill)(params, prompt)
+        run = jax.jit(decode_n).lower(params, cache, token).compile()
+        last = run(params, cache, token)  # warmup
+        int(jax.device_get(last)[0])
+        t0 = time.time()
+        last = run(params, cache, token)
+        int(jax.device_get(last)[0])
+        elapsed = time.time() - t0
+        results[f"decode_tokens_per_sec_{cache_dtype}"] = round(
+            batch * decode_tokens / elapsed, 2
+        )
+        results[f"decode_ms_per_step_{cache_dtype}"] = round(
+            1000 * elapsed / decode_tokens, 3
+        )
+    return {
+        "batch": batch, "prefill": prefill_len,
+        "decode_tokens": decode_tokens, **results,
+    }
+
+
 def bench_ici_allreduce(tpu: bool):
     from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -274,6 +358,7 @@ CONFIGS = {
     "vit_base": bench_vit_base,
     "llama_lora": bench_llama_lora,
     "long_context": bench_long_context,
+    "decode": bench_decode,
     "ici_allreduce": bench_ici_allreduce,
 }
 

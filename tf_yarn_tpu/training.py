@@ -266,19 +266,36 @@ class _StepsPerSecondHook:
             )
 
 
-def _preempt_agreed() -> bool:
+def _preempt_agreed(state) -> bool:
     """Whether ALL hosts should drain now. SIGTERM delivery is per-host
     and skewed; a host draining alone would start a multi-host checkpoint
     save (a collective) its peers never join — deadlock until the grace
     window's SIGKILL. Every host calls this at every host boundary (the
     SPMD loop keeps boundaries in lockstep), so the allgather is safe and
-    the max makes one host's flag everyone's decision."""
+    the max makes one host's flag everyone's decision.
+
+    The block_until_ready is load-bearing: dispatched train steps are
+    async, and posting the host-side allgather while a step's own
+    collectives are still in flight interleaves two collectives on one
+    Gloo/ICI channel — the peers then see mismatched op sequences
+    ("Received data size doesn't match expected size"). Draining local
+    dispatch first makes every process's per-channel order
+    [steps..., allgather], identically.
+
+    The guards short-circuiting this call (input_exhausted,
+    step < train_steps) are host-uniform by the same SPMD contract the
+    train step's own collectives already depend on: equal per-host batch
+    counts and one shared train_steps. A host whose stream ran short
+    would desynchronize the *training* collectives regardless of this
+    check — uneven shards must be evened by the input pipeline
+    (drop-last semantics, as data/parquet.py does)."""
     import jax
 
     if jax.process_count() == 1:
         return preemption.requested()
     from jax.experimental import multihost_utils
 
+    jax.block_until_ready(state)
     flags = multihost_utils.process_allgather(
         np.int32(preemption.requested())
     )
@@ -586,9 +603,9 @@ def train_and_evaluate(
                     state, metrics = run_single(state, batch)
                     step += 1
                 if (
-                    _preempt_agreed()
-                    and not input_exhausted
+                    not input_exhausted
                     and step < params_cfg.train_steps
+                    and _preempt_agreed(state)
                 ):
                     # First thing at the host boundary — before eval/log
                     # work that could outlive the SIGTERM grace window.

@@ -195,6 +195,31 @@ class KerasExperiment:
 
 
 @dataclasses.dataclass
+class InferenceExperiment:
+    """Batch-inference job: load a checkpoint, run KV-cache generation
+    over an input stream, write results.
+
+    No reference analog (tf-yarn launches training only); completes the
+    model lifecycle train → checkpoint → batch inference on the same
+    launcher. `input_fn` yields dict batches with "tokens" [B, P] int32
+    (fixed shapes per batch — XLA recompiles per new shape) and any extra
+    keys to echo into the output records (e.g. ids). An `input_fn` may
+    declare (shard, num_shards) keywords to split the stream across task
+    instances. Results land as JSON lines at `output_path` (suffixed
+    `-<task_id>` when there are multiple instances)."""
+
+    model: Any
+    model_dir: str
+    input_fn: InputFn
+    output_path: str
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    eos_token: Optional[int] = None
+    step: Optional[int] = None  # checkpoint step; None = latest
+
+
+@dataclasses.dataclass
 class CoreExperiment:
     """Normalized form consumed by training.train_and_evaluate."""
 
@@ -277,11 +302,18 @@ def as_core_experiment(experiment: Any) -> CoreExperiment:
     raise TypeError(f"cannot normalize experiment of type {type(experiment)!r}")
 
 
-EXPERIMENT_TYPES = (JaxExperiment, ExperimentSpec, KerasExperiment)
+EXPERIMENT_TYPES = (
+    JaxExperiment, ExperimentSpec, KerasExperiment, InferenceExperiment
+)
 
 
 def run_experiment(runtime, experiment: Any) -> None:
     """Entry used by tasks/worker.py."""
+    if isinstance(experiment, InferenceExperiment):
+        from tf_yarn_tpu import inference
+
+        inference.run_inference(experiment, runtime=runtime)
+        return
     from tf_yarn_tpu import training
 
     training.train_and_evaluate(as_core_experiment(experiment), runtime=runtime)

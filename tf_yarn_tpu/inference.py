@@ -1,0 +1,121 @@
+"""Batch inference: checkpoint -> KV-cache generation -> JSONL records.
+
+The runner behind `InferenceExperiment` (tf_yarn_tpu/experiment.py): the
+train → checkpoint → generate lifecycle on the same launcher, task
+programs and coordination the training path uses. No reference analog
+(tf-yarn launches training only).
+
+Sharding across task instances is the input_fn's choice: declare
+``(shard, num_shards)`` keywords to receive this task's slice of the
+stream; instance outputs are suffixed ``-<task_id>`` so they never
+collide on a shared filesystem.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import logging
+import time
+from typing import Optional
+
+import numpy as np
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+
+_logger = logging.getLogger(__name__)
+
+
+def _call_input_fn(input_fn, shard: int, num_shards: int):
+    try:
+        params = inspect.signature(input_fn).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "shard" in params and "num_shards" in params:
+        return input_fn(shard=shard, num_shards=num_shards)
+    if num_shards > 1:
+        _logger.warning(
+            "input_fn takes no (shard, num_shards): every task instance "
+            "will process the FULL stream (duplicate outputs). Declare "
+            "the keywords to split it."
+        )
+    return input_fn()
+
+
+def _restore_params(model_dir: str, step: Optional[int]):
+    """Host-restore the checkpointed TrainState and keep its params:
+    topology-independent (restore_checkpoint_host), so an inference job
+    can run on a different device count than training used."""
+    if step is None:
+        step = ckpt_lib.latest_checkpoint_step(model_dir)
+        if step is None:
+            raise FileNotFoundError(f"no ckpt-<step> under {model_dir}")
+    state = ckpt_lib.restore_checkpoint_host(model_dir, step)
+    params = state["params"] if isinstance(state, dict) else state.params
+    return {"params": params}, step
+
+
+def run_inference(experiment, runtime=None) -> dict:
+    """Generate for every batch of the (sharded) input stream; returns
+    summary stats ({"records", "batches", "tokens_per_sec"})."""
+    from tf_yarn_tpu.models.generate import generate
+
+    shard, num_shards = 0, 1
+    if runtime is not None:
+        shard = runtime.task_key.id
+        num_shards = sum(
+            1 for ti in runtime.cluster_tasks if ti.key.type == runtime.task_key.type
+        )
+    variables, step = _restore_params(experiment.model_dir, experiment.step)
+    _logger.info(
+        "inference from ckpt-%d, shard %d/%d -> %s",
+        step, shard, num_shards, experiment.output_path,
+    )
+
+    out_path = experiment.output_path
+    if num_shards > 1:
+        out_path = f"{out_path}-{shard}"
+
+    records = batches = 0
+    new_tokens = 0
+    t0 = time.time()
+    with open(out_path, "w") as out:
+        for batch in _call_input_fn(experiment.input_fn, shard, num_shards):
+            tokens = np.asarray(batch["tokens"], np.int32)
+            sequences = generate(
+                experiment.model,
+                variables,
+                tokens,
+                max_new_tokens=experiment.max_new_tokens,
+                temperature=experiment.temperature,
+                top_k=experiment.top_k,
+                eos_token=experiment.eos_token,
+            )
+            sequences = np.asarray(sequences)
+            extras = {
+                key: np.asarray(value)
+                for key, value in batch.items()
+                if key != "tokens"
+            }
+            for row in range(sequences.shape[0]):
+                record = {
+                    "prompt": tokens[row].tolist(),
+                    "tokens": sequences[row, tokens.shape[1]:].tolist(),
+                }
+                for key, value in extras.items():
+                    record[key] = np.asarray(value[row]).tolist()
+                out.write(json.dumps(record) + "\n")
+                records += 1
+            batches += 1
+            new_tokens += sequences.shape[0] * (
+                sequences.shape[1] - tokens.shape[1]
+            )
+    elapsed = max(time.time() - t0, 1e-9)
+    stats = {
+        "records": records,
+        "batches": batches,
+        "ckpt_step": step,
+        "tokens_per_sec": round(new_tokens / elapsed, 2),
+    }
+    _logger.info("inference done: %s", stats)
+    return stats

@@ -1,0 +1,78 @@
+"""End-to-end batch inference: train -> checkpoint -> run_inference.
+
+Covers the full InferenceExperiment path (restore params from a real
+training checkpoint, KV-cache generation, JSONL output) — the lifecycle
+the launcher runs via tasks/worker.py. No reference analog (tf-yarn
+launches training only)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu.experiment import InferenceExperiment, as_core_experiment
+from tf_yarn_tpu.inference import run_inference
+from tf_yarn_tpu.models import transformer
+from tf_yarn_tpu.parallel.mesh import select_devices
+from tf_yarn_tpu.training import train_and_evaluate
+
+
+def _trained_model_dir(tmp_path):
+    cfg = transformer.TransformerConfig.tiny(max_seq_len=32)
+    experiment = transformer.make_experiment(
+        config=cfg,
+        model_dir=str(tmp_path),
+        train_steps=4,
+        batch_size=4,
+        seq_len=16,
+    )
+    train_and_evaluate(
+        as_core_experiment(experiment), devices=select_devices(1, platform="cpu")
+    )
+    return transformer.Transformer(cfg), str(tmp_path)
+
+
+def _two_batch_stream(vocab_size=256):
+    rng = np.random.RandomState(0)
+    for start in range(2):
+        yield {
+            "tokens": rng.randint(0, vocab_size, (2, 5)).astype(np.int32),
+            "id": np.arange(start * 2, start * 2 + 2),
+        }
+
+
+def test_run_inference_end_to_end(tmp_path):
+    model, model_dir = _trained_model_dir(tmp_path / "model")
+    out_path = str(tmp_path / "out.jsonl")
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=_two_batch_stream,
+        output_path=out_path,
+        max_new_tokens=3,
+        temperature=0.0,
+    )
+    stats = run_inference(experiment)
+    assert stats["records"] == 4
+    assert stats["batches"] == 2
+    assert stats["ckpt_step"] == 4
+
+    records = [json.loads(line) for line in open(out_path)]
+    assert len(records) == 4
+    for record in records:
+        assert len(record["prompt"]) == 5
+        assert len(record["tokens"]) == 3
+        assert "id" in record
+    assert [r["id"] for r in records] == [0, 1, 2, 3]
+
+
+def test_run_inference_missing_checkpoint(tmp_path):
+    cfg = transformer.TransformerConfig.tiny(max_seq_len=32)
+    experiment = InferenceExperiment(
+        model=transformer.Transformer(cfg),
+        model_dir=str(tmp_path / "empty"),
+        input_fn=_two_batch_stream,
+        output_path=str(tmp_path / "out.jsonl"),
+    )
+    with pytest.raises(FileNotFoundError):
+        run_inference(experiment)

@@ -52,7 +52,10 @@ def _restore_params(model_dir: str, step: Optional[int]):
             raise FileNotFoundError(f"no ckpt-<step> under {model_dir}")
     state = ckpt_lib.restore_checkpoint_host(model_dir, step)
     params = state["params"] if isinstance(state, dict) else state.params
-    return {"params": params}, step
+    # TrainState.params as checkpointed is already the full flax variables
+    # dict ({"params": ...}) — see training.py init_state — so return it
+    # as-is; re-wrapping would double-nest and break model.apply.
+    return params, step
 
 
 def run_inference(experiment, runtime=None) -> dict:

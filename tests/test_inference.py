@@ -66,6 +66,99 @@ def test_run_inference_end_to_end(tmp_path):
     assert [r["id"] for r in records] == [0, 1, 2, 3]
 
 
+class _FakeRuntime:
+    """Just enough of TaskRuntime for run_inference's sharding math."""
+
+    def __init__(self, task_id, n_instances):
+        from tf_yarn_tpu.topologies import TaskKey
+
+        class _TI:
+            def __init__(self, key):
+                self.key = key
+
+        self.task_key = TaskKey("worker", task_id)
+        self.cluster_tasks = [
+            _TI(TaskKey("worker", i)) for i in range(n_instances)
+        ]
+
+
+def test_multi_instance_unsharded_input_fails_fast(tmp_path):
+    model, model_dir = _trained_model_dir(tmp_path / "model")
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=_two_batch_stream,  # no (shard, num_shards) keywords
+        output_path=str(tmp_path / "out.jsonl"),
+        max_new_tokens=2,
+    )
+    with pytest.raises(ValueError, match="shard"):
+        run_inference(experiment, runtime=_FakeRuntime(0, 2))
+
+    # Explicit opt-in restores the old duplicate-stream behavior.
+    experiment = dataclasses_replace(experiment, allow_duplicate_stream=True)
+    stats = run_inference(experiment, runtime=_FakeRuntime(1, 2))
+    assert stats["records"] == 4
+    # Instance outputs stay suffixed so they never collide.
+    assert (tmp_path / "out.jsonl-1").exists()
+
+
+def dataclasses_replace(experiment, **kwargs):
+    import dataclasses
+
+    return dataclasses.replace(experiment, **kwargs)
+
+
+def test_sharded_input_fn_splits_stream(tmp_path):
+    model, model_dir = _trained_model_dir(tmp_path / "model")
+
+    def sharded_stream(shard, num_shards):
+        rng = np.random.RandomState(0)
+        for index in range(4):
+            batch = rng.randint(0, 256, (1, 5)).astype(np.int32)
+            if index % num_shards == shard:
+                yield {"tokens": batch, "idx": np.asarray([index])}
+
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=sharded_stream,
+        output_path=str(tmp_path / "out.jsonl"),
+        max_new_tokens=2,
+    )
+    stats = run_inference(experiment, runtime=_FakeRuntime(1, 2))
+    assert stats["records"] == 2
+    records = [json.loads(line) for line in open(str(tmp_path / "out.jsonl-1"))]
+    assert [r["idx"] for r in records] == [1, 3]
+
+
+def test_inference_output_to_fs_uri(tmp_path):
+    from pyarrow import fs as pafs
+
+    from tf_yarn_tpu import fs as fs_lib
+
+    base = tmp_path / "remote"
+    base.mkdir()
+    local = pafs.LocalFileSystem()
+    fs_lib.register_scheme(
+        "mockout", lambda uri: (local, str(base / uri[len("mockout://"):]))
+    )
+    try:
+        model, model_dir = _trained_model_dir(tmp_path / "model")
+        experiment = InferenceExperiment(
+            model=model,
+            model_dir=model_dir,
+            input_fn=_two_batch_stream,
+            output_path="mockout://results/out.jsonl",
+            max_new_tokens=2,
+        )
+        stats = run_inference(experiment)
+        assert stats["records"] == 4
+        lines = (base / "results" / "out.jsonl").read_text().splitlines()
+        assert len(lines) == 4
+    finally:
+        fs_lib.unregister_scheme("mockout")
+
+
 def test_run_inference_missing_checkpoint(tmp_path):
     cfg = transformer.TransformerConfig.tiny(max_seq_len=32)
     experiment = InferenceExperiment(

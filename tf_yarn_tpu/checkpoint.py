@@ -3,38 +3,58 @@
 The reference delegates checkpointing to TF Estimator / explicit torch
 saves with epoch-numbered files and regex discovery (reference:
 pytorch/model_ckpt.py:15-73; Estimator `model.ckpt-<step>` parsing in
-evaluator_task.py:130-131). Here checkpoints are orbax pytrees in
-``<model_dir>/ckpt-<step>`` directories: sharded-array aware (each host
+evaluator_task.py:130-131) — always against a filesystem URL (HDFS via
+cluster_pack.filesystem / tf.io.gfile). Here checkpoints are orbax pytrees
+in ``<model_dir>/ckpt-<step>`` directories: sharded-array aware (each host
 writes its shards — the multi-host story the reference never had) and
 discoverable by the same name-parsing convention so the side-car evaluator
 can diff "checkpoints on disk" vs "checkpoints evaluated".
+
+``model_dir`` may be a URI (tf_yarn_tpu.fs): discovery, retention GC and
+eval markers work on any pyarrow filesystem. The tensor payload has three
+paths:
+
+* local / ``file://`` — orbax writes directly;
+* ``gs://`` — orbax writes directly (tensorstore speaks GCS);
+* any other scheme (``hdfs://``, registered vendor fs) — **staged**: orbax
+  writes a local temp dir, the tree is uploaded to
+  ``.staging-ckpt-<step>`` (invisible to discovery) and renamed into
+  place, so pollers only ever see committed checkpoints. Staged mode is
+  single-host only: multi-host jobs write shards from every process and
+  need a filesystem orbax can target directly (shared mount or gs://).
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import re
+import tempfile
 from typing import Any, List, Optional
+
+from tf_yarn_tpu import fs as fs_lib
 
 _logger = logging.getLogger(__name__)
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 
+# Schemes orbax/tensorstore writes without staging.
+_ORBAX_NATIVE_SCHEMES = ("gs",)
+
 
 def checkpoint_path(model_dir: str, step: int) -> str:
-    return os.path.join(model_dir, f"ckpt-{step}")
+    return fs_lib.join(model_dir, f"ckpt-{step}")
 
 
 def list_checkpoint_steps(model_dir: str) -> List[int]:
     """All completed checkpoint steps, ascending (reference's regex
-    discovery, model_ckpt.py:15-28)."""
-    if not os.path.isdir(model_dir):
-        return []
+    discovery, model_ckpt.py:15-28; works on any fs URI like the
+    reference's tf.io.gfile listing, evaluator_task.py:38-51)."""
     steps = []
-    for entry in os.listdir(model_dir):
-        match = _CKPT_RE.match(entry)
-        if match and os.path.isdir(os.path.join(model_dir, entry)):
+    for name, is_dir in fs_lib.listdir(model_dir):
+        match = _CKPT_RE.match(name)
+        if match and is_dir:
             steps.append(int(match.group(1)))
     return sorted(steps)
 
@@ -44,6 +64,75 @@ def latest_checkpoint_step(model_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _is_staged(model_dir: str) -> bool:
+    scheme = fs_lib.parse_scheme(model_dir)
+    return scheme not in ("", "file") and scheme not in _ORBAX_NATIVE_SCHEMES
+
+
+def _require_single_host(what: str) -> None:
+    import jax
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            f"{what} is single-host only: every process writes its own "
+            "array shards, and staging-then-uploading per host would "
+            "scatter one checkpoint across machines. Multi-host jobs need "
+            "a model_dir orbax can write directly — a shared mount or "
+            "gs://."
+        )
+
+
+def _orbax_target(model_dir: str, step: int) -> str:
+    """The path handed to orbax for a DIRECT (non-staged) save/restore."""
+    path = checkpoint_path(model_dir, step)
+    if fs_lib.is_local(path):
+        return os.path.abspath(fs_lib.local_path(path))
+    return path
+
+
+def _commit_staged(local_ckpt: str, model_dir: str, step: int) -> None:
+    """Upload a locally-written ckpt tree and rename it into place.
+
+    The staging name never matches the ckpt-<step> regex, so a polling
+    evaluator can't observe a half-uploaded checkpoint."""
+    staging = fs_lib.join(model_dir, f".staging-ckpt-{step}")
+    final = checkpoint_path(model_dir, step)
+    fs_lib.rmtree(staging)
+    fs_lib.mkdirs(model_dir)
+    fs_lib.upload_dir(local_ckpt, staging)
+    # Delete a same-step predecessor only once its replacement is fully
+    # uploaded (force semantics, matching orbax save(force=True)) — an
+    # upload failure must never cost the last good checkpoint.
+    fs_lib.rmtree(final)
+    fs_lib.move(staging, final)
+
+
+def _staged_save(model_dir: str, step: int, state: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    _require_single_host("staged remote checkpointing")
+    with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
+        local = os.path.join(tmp, f"ckpt-{step}")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(local, state, force=True)
+        _commit_staged(local, model_dir, step)
+
+
+@contextlib.contextmanager
+def _restorable_path(model_dir: str, step: int):
+    """Yield a path orbax can restore from — fetching the tree to a local
+    temp dir first when the scheme needs staging."""
+    if not _is_staged(model_dir):
+        yield _orbax_target(model_dir, step)
+        return
+    with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-fetch-") as tmp:
+        local = os.path.join(tmp, f"ckpt-{step}")
+        n = fs_lib.download_dir(checkpoint_path(model_dir, step), local)
+        if n == 0:
+            raise FileNotFoundError(checkpoint_path(model_dir, step))
+        yield local
+
+
 def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
     """Write `state` (any pytree of arrays) as ckpt-<step>, synchronously.
 
@@ -51,9 +140,12 @@ def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
     as the simple one-shot API for tools and tests."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(checkpoint_path(model_dir, step))
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, state, force=True)
+    path = checkpoint_path(model_dir, step)
+    if _is_staged(model_dir):
+        _staged_save(model_dir, step, state)
+    else:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(_orbax_target(model_dir, step), state, force=True)
     _logger.info("saved checkpoint %s", path)
     return path
 
@@ -68,7 +160,9 @@ class CheckpointWriter:
     threads. Orbax writes into a `.orbax-checkpoint-tmp` staging dir and
     renames on commit, and `list_checkpoint_steps`'s `ckpt-<step>` regex
     never matches staging names — so a concurrently polling side-car
-    evaluator (evaluation.py) only ever sees completed checkpoints.
+    evaluator (evaluation.py) only ever sees completed checkpoints. The
+    same holds on staged-remote filesystems via `.staging-ckpt-<step>`
+    upload + rename.
 
     Retention: before each save, completed `ckpt-*` dirs beyond the
     newest `keep_last_n` are deleted (the Estimator-style keep_max
@@ -82,17 +176,59 @@ class CheckpointWriter:
 
         self.keep_last_n = keep_last_n
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        self._executor = None  # staged-upload worker, created on demand
+        self._staged_futures: list = []
 
     def save(self, model_dir: str, step: int, state: Any) -> str:
         import orbax.checkpoint as ocp
 
         self._gc(model_dir)
-        path = os.path.abspath(checkpoint_path(model_dir, step))
-        self._ckptr.save(
-            path, args=ocp.args.StandardSave(state), force=True
-        )
+        path = checkpoint_path(model_dir, step)
+        if _is_staged(model_dir):
+            self._staged_async_save(model_dir, step, state)
+        else:
+            self._ckptr.save(
+                _orbax_target(model_dir, step),
+                args=ocp.args.StandardSave(state),
+                force=True,
+            )
         _logger.info("checkpoint %s save started (async)", path)
         return path
+
+    def _staged_async_save(self, model_dir: str, step: int, state: Any) -> None:
+        """Snapshot to host now (preserving the donation guarantee), then
+        serialize + upload + rename on the worker thread."""
+        import concurrent.futures
+
+        import jax
+
+        _require_single_host("staged remote checkpointing")
+        # Backpressure: at most one upload in flight. Each snapshot pins a
+        # full host-RAM copy of the state; letting them queue behind a
+        # slow link would grow memory without bound.
+        self._raise_staged_errors(block=True)
+        snapshot = jax.tree_util.tree_map(
+            lambda leaf: jax.device_get(leaf)
+            if isinstance(leaf, jax.Array)
+            else leaf,
+            state,
+        )
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-stage"
+            )
+        self._staged_futures.append(
+            self._executor.submit(_staged_save, model_dir, step, snapshot)
+        )
+
+    def _raise_staged_errors(self, block: bool) -> None:
+        pending = []
+        for future in self._staged_futures:
+            if block or future.done():
+                future.result()  # re-raises upload failures
+            else:
+                pending.append(future)
+        self._staged_futures = pending
 
     def _gc(self, model_dir: str) -> None:
         if not self.keep_last_n:
@@ -101,22 +237,24 @@ class CheckpointWriter:
 
         if jax.process_index() != 0:
             return
-        import shutil
-
         # Only completed checkpoints are listed, so an in-flight save can
         # never be collected out from under its commit.
         steps = list_checkpoint_steps(model_dir)
         for step in steps[: -self.keep_last_n]:
             path = checkpoint_path(model_dir, step)
             _logger.info("retention(%d): deleting %s", self.keep_last_n, path)
-            shutil.rmtree(path, ignore_errors=True)
+            fs_lib.rmtree(path)
 
     def wait(self) -> None:
         """Block until every started save has committed."""
         self._ckptr.wait_until_finished()
+        self._raise_staged_errors(block=True)
 
     def close(self) -> None:
         self._ckptr.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._raise_staged_errors(block=True)
 
     def __enter__(self):
         return self
@@ -130,14 +268,16 @@ def restore_checkpoint(model_dir: str, step: int, target: Optional[Any] = None) 
     ShapeDtypeStructs with shardings) directs placement on restore."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(checkpoint_path(model_dir, step))
-    with ocp.StandardCheckpointer() as ckptr:
-        if target is None:
-            return ckptr.restore(path)
-        import jax
+    with _restorable_path(model_dir, step) as path:
+        with ocp.StandardCheckpointer() as ckptr:
+            if target is None:
+                return ckptr.restore(path)
+            import jax
 
-        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)
-        return ckptr.restore(path, abstract)
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, target
+            )
+            return ckptr.restore(path, abstract)
 
 
 def restore_checkpoint_host(model_dir: str, step: int) -> Any:
@@ -148,14 +288,14 @@ def restore_checkpoint_host(model_dir: str, step: int) -> Any:
     import numpy as np
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(checkpoint_path(model_dir, step))
-    with ocp.PyTreeCheckpointer() as ckptr:
-        item = ckptr.metadata(path).item_metadata
-        tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
-        restore_args = jax.tree_util.tree_map(
-            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
-        )
-        return ckptr.restore(path, restore_args=restore_args)
+    with _restorable_path(model_dir, step) as path:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            item = ckptr.metadata(path).item_metadata
+            tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
+            restore_args = jax.tree_util.tree_map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+            )
+            return ckptr.restore(path, restore_args=restore_args)
 
 
 def restore_latest(model_dir: str, target: Optional[Any] = None):

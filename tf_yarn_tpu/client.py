@@ -217,6 +217,12 @@ def _setup_cluster(
 ) -> SliceCluster:
     log_dir = tempfile.mkdtemp(prefix=f"{name}-logs-")
     server = start_best_server(host=coordinator_bind)
+    if getattr(backend, "is_remote", True):
+        # Tasks land on other machines: let fs.check_model_dir_placement
+        # fail fast on host-local model_dirs (shared mounts opt out via
+        # TPU_YARN_ALLOW_LOCAL_MODEL_DIR=1).
+        env = dict(env)
+        env.setdefault("TPU_YARN_REMOTE_BACKEND", "1")
     try:
         kv = KVClient(server.endpoint)
         services = _setup_task_env(

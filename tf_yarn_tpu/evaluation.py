@@ -29,6 +29,7 @@ import jax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
 from tf_yarn_tpu import event
+from tf_yarn_tpu import fs as fs_lib
 from tf_yarn_tpu.experiment import as_core_experiment
 from tf_yarn_tpu.tasks import _bootstrap
 from tf_yarn_tpu.training import build_eval_step, evaluate
@@ -45,9 +46,7 @@ EVAL_DONE_DIR = "eval-done"  # bookkeeping lives out of checkpoint listings
 
 def _marker_steps(directory: str) -> Set[int]:
     done: Set[int] = set()
-    if not os.path.isdir(directory):
-        return done
-    for entry in os.listdir(directory):
+    for entry, _is_dir in fs_lib.listdir(directory):
         if entry.startswith("eval-done-") and entry.endswith(".json"):
             try:
                 done.add(int(entry[len("eval-done-"):-len(".json")]))
@@ -59,18 +58,17 @@ def _marker_steps(directory: str) -> Set[int]:
 def _evaluated_steps(model_dir: str) -> Set[int]:
     # Markers written before the subdirectory move lived at the model_dir
     # root; honor both so resuming against an old run doesn't re-evaluate
-    # (and re-emit metrics for) every checkpoint.
-    return _marker_steps(os.path.join(model_dir, EVAL_DONE_DIR)) | _marker_steps(
+    # (and re-emit metrics for) every checkpoint. model_dir may be any fs
+    # URI (the reference lists its HDFS model_dir the same way,
+    # evaluator_task.py:38-51).
+    return _marker_steps(fs_lib.join(model_dir, EVAL_DONE_DIR)) | _marker_steps(
         model_dir
     )
 
 
 def _mark_evaluated(model_dir: str, step: int, metrics: dict) -> None:
-    marker_dir = os.path.join(model_dir, EVAL_DONE_DIR)
-    os.makedirs(marker_dir, exist_ok=True)
-    path = os.path.join(marker_dir, f"eval-done-{step}.json")
-    with open(path, "w") as fh:
-        json.dump(metrics, fh)
+    marker = fs_lib.join(model_dir, EVAL_DONE_DIR, f"eval-done-{step}.json")
+    fs_lib.write_text(marker, json.dumps(metrics))
 
 
 def evaluate_checkpoint(
@@ -113,6 +111,7 @@ def continuous_eval(
     core = as_core_experiment(experiment)
     if not core.model_dir:
         raise ValueError("continuous evaluation needs an experiment model_dir")
+    fs_lib.check_model_dir_placement(core.model_dir)
     eval_input_fn = core.eval_input_fn or core.train_input_fn
     eval_step = jax.jit(build_eval_step(core.model, core.loss_fn))
     rng = jax.random.PRNGKey(core.train_params.seed)

@@ -217,6 +217,9 @@ class InferenceExperiment:
     top_k: Optional[int] = None
     eos_token: Optional[int] = None
     step: Optional[int] = None  # checkpoint step; None = latest
+    # Multi-instance jobs whose input_fn ignores (shard, num_shards) fail
+    # fast unless duplication of the full stream is explicitly intended.
+    allow_duplicate_stream: bool = False
 
 
 @dataclasses.dataclass

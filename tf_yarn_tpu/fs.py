@@ -1,0 +1,248 @@
+"""URI filesystem layer: one seam for every `model_dir`-like path.
+
+The reference reaches HDFS everywhere through `cluster_pack.filesystem` /
+`tf.io.gfile` (reference: pytorch/model_ckpt.py:31-44 resolves any
+filesystem URL; tensorflow/tasks/evaluator_task.py:38-51 lists an HDFS
+model_dir). Here the same role is played by pyarrow.fs: every subsystem
+that touches a user-supplied directory (checkpoint discovery/retention,
+eval-done markers, inference output, packaging uploads) resolves it
+through this module, so a `model_dir` may be a plain path, `file://`,
+`gs://`, `hdfs://`, or any scheme registered via :func:`register_scheme`
+(the vendor-filesystem seam; also how tests mount a fake remote fs).
+
+Plain paths and `file://` resolve to the local filesystem; everything else
+goes to `pyarrow.fs.FileSystem.from_uri` unless a registered factory
+claims the scheme first.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import re
+import shutil
+from typing import Callable, Dict, List, Tuple
+
+_logger = logging.getLogger(__name__)
+
+_SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+
+# scheme -> factory(uri) -> (pyarrow FileSystem, path-within-fs)
+_REGISTRY: Dict[str, Callable[[str], Tuple[object, str]]] = {}
+
+
+def register_scheme(scheme: str, factory: Callable[[str], Tuple[object, str]]) -> None:
+    """Route `scheme://...` URIs through `factory(uri) -> (fs, path)`.
+
+    Overrides pyarrow's own resolution for that scheme — the seam for
+    vendor filesystems (the cluster_pack.filesystem role) and for tests
+    that need a fake remote fs (e.g. a SubTreeFileSystem over a temp dir).
+    """
+    _REGISTRY[scheme] = factory
+    _resolve_remote.cache_clear()
+
+
+def unregister_scheme(scheme: str) -> None:
+    _REGISTRY.pop(scheme, None)
+    _resolve_remote.cache_clear()
+
+
+def parse_scheme(uri: str) -> str:
+    """"gs://b/p" -> "gs"; plain paths -> ""."""
+    match = _SCHEME_RE.match(uri)
+    return match.group(1) if match else ""
+
+
+def is_local(uri: str) -> bool:
+    """True when `uri` lives on this host's filesystem (no scheme or
+    file://) — the "needs shared storage" test for multi-host runs."""
+    return parse_scheme(uri) in ("", "file")
+
+
+def local_path(uri: str) -> str:
+    """The plain local path of a local uri (strips file://)."""
+    scheme = parse_scheme(uri)
+    if scheme == "file":
+        return uri[len("file://"):]
+    if scheme == "":
+        return uri
+    raise ValueError(f"{uri!r} is not a local path")
+
+
+@functools.lru_cache(maxsize=256)
+def _resolve_remote(uri: str):
+    """Cached remote resolution: polling loops hit the same uris every
+    interval, and constructing a fresh HadoopFileSystem/GcsFileSystem per
+    call would open a new client connection each time (pyarrow
+    filesystems are thread-safe, so sharing is sound)."""
+    from pyarrow import fs as pafs
+
+    scheme = parse_scheme(uri)
+    if scheme in _REGISTRY:
+        return _REGISTRY[scheme](uri)
+    return pafs.FileSystem.from_uri(uri)
+
+
+def resolve(uri: str):
+    """uri -> (pyarrow FileSystem, path-within-fs)."""
+    from pyarrow import fs as pafs
+
+    if parse_scheme(uri) == "":
+        return pafs.LocalFileSystem(), os.path.abspath(uri)
+    return _resolve_remote(uri)
+
+
+def join(uri: str, *parts: str) -> str:
+    """Path join that preserves the uri scheme."""
+    if parse_scheme(uri) == "":
+        return os.path.join(uri, *parts)
+    return "/".join([uri.rstrip("/"), *parts])
+
+
+def exists(uri: str) -> bool:
+    from pyarrow import fs as pafs
+
+    filesystem, path = resolve(uri)
+    return filesystem.get_file_info(path).type != pafs.FileType.NotFound
+
+
+def isdir(uri: str) -> bool:
+    from pyarrow import fs as pafs
+
+    filesystem, path = resolve(uri)
+    return filesystem.get_file_info(path).type == pafs.FileType.Directory
+
+
+def listdir(uri: str) -> List[Tuple[str, bool]]:
+    """[(base_name, is_dir)] of the directory's children; [] when the
+    directory doesn't exist (discovery loops poll before training has
+    created model_dir)."""
+    from pyarrow import fs as pafs
+
+    filesystem, path = resolve(uri)
+    if filesystem.get_file_info(path).type != pafs.FileType.Directory:
+        return []
+    selector = pafs.FileSelector(path, recursive=False)
+    return [
+        (os.path.basename(info.path.rstrip("/")), info.type == pafs.FileType.Directory)
+        for info in filesystem.get_file_info(selector)
+    ]
+
+
+def mkdirs(uri: str) -> None:
+    filesystem, path = resolve(uri)
+    filesystem.create_dir(path, recursive=True)
+
+
+def rmtree(uri: str) -> None:
+    """Delete a directory tree; missing targets are a no-op (retention GC
+    races with concurrent deleters)."""
+    from pyarrow import fs as pafs
+
+    filesystem, path = resolve(uri)
+    try:
+        filesystem.delete_dir(path)
+    except Exception as exc:
+        if filesystem.get_file_info(path).type != pafs.FileType.NotFound:
+            raise
+        _logger.debug("rmtree(%s): already gone (%s)", uri, exc)
+
+
+def move(src_uri: str, dst_uri: str) -> None:
+    """Rename within one filesystem (the commit step of staged uploads)."""
+    src_fs, src_path = resolve(src_uri)
+    _dst_fs, dst_path = resolve(dst_uri)
+    src_fs.move(src_path, dst_path)
+
+
+def open_output(uri: str):
+    """Binary writable stream; parent directories are created."""
+    filesystem, path = resolve(uri)
+    parent = os.path.dirname(path.rstrip("/"))
+    if parent:
+        filesystem.create_dir(parent, recursive=True)
+    return filesystem.open_output_stream(path)
+
+
+def open_input(uri: str):
+    filesystem, path = resolve(uri)
+    return filesystem.open_input_stream(path)
+
+
+def open_input_file(uri: str):
+    """Seekable (random-access) reader — torch.load and friends need
+    seek(), which plain input streams don't provide."""
+    filesystem, path = resolve(uri)
+    return filesystem.open_input_file(path)
+
+
+def write_text(uri: str, text: str) -> None:
+    with open_output(uri) as stream:
+        stream.write(text.encode("utf-8"))
+
+
+def read_text(uri: str) -> str:
+    with open_input(uri) as stream:
+        return stream.read().decode("utf-8")
+
+
+def upload_dir(local_dir: str, uri: str) -> int:
+    """Recursively copy a local tree to `uri`; returns files copied."""
+    filesystem, target = resolve(uri)
+    copied = 0
+    for root, _dirs, files in os.walk(local_dir):
+        rel_root = os.path.relpath(root, local_dir)
+        remote_root = target if rel_root == "." else f"{target}/{rel_root}"
+        filesystem.create_dir(remote_root, recursive=True)
+        for name in files:
+            with open(os.path.join(root, name), "rb") as src, \
+                    filesystem.open_output_stream(f"{remote_root}/{name}") as dst:
+                shutil.copyfileobj(src, dst, 1 << 20)
+            copied += 1
+    return copied
+
+
+def download_dir(uri: str, local_dir: str) -> int:
+    """Recursively copy `uri`'s tree to a local directory."""
+    from pyarrow import fs as pafs
+
+    filesystem, path = resolve(uri)
+    os.makedirs(local_dir, exist_ok=True)
+    selector = pafs.FileSelector(path, recursive=True)
+    copied = 0
+    for info in filesystem.get_file_info(selector):
+        rel = os.path.relpath(info.path, path)
+        dst = os.path.join(local_dir, rel)
+        if info.type == pafs.FileType.Directory:
+            os.makedirs(dst, exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with filesystem.open_input_stream(info.path) as src, open(dst, "wb") as out:
+                shutil.copyfileobj(src, out, 1 << 20)
+            copied += 1
+    return copied
+
+
+def check_model_dir_placement(model_dir: str) -> None:
+    """Fail fast when a remote-backend run points model_dir at host-local
+    storage: each host would write `ckpt-*` shards to its own disk and a
+    restore or side-car eval from another host silently sees nothing (the
+    reference's deployments avoid this by construction — model_dir is
+    always HDFS, SURVEY.md §5 checkpoint/resume). A shared mount (NFS) at
+    a local path is legitimate: declare it with
+    TPU_YARN_ALLOW_LOCAL_MODEL_DIR=1.
+    """
+    if not model_dir or not is_local(model_dir):
+        return
+    if not os.environ.get("TPU_YARN_REMOTE_BACKEND"):
+        return
+    if os.environ.get("TPU_YARN_ALLOW_LOCAL_MODEL_DIR"):
+        return
+    raise ValueError(
+        f"model_dir {model_dir!r} is host-local but this task was launched "
+        "by a remote (multi-machine) backend: checkpoints and eval markers "
+        "would land on each host's own disk. Use a shared filesystem URI "
+        "(gs://, hdfs://, ...) — or set TPU_YARN_ALLOW_LOCAL_MODEL_DIR=1 "
+        "if this path is a shared mount."
+    )

@@ -14,6 +14,7 @@ collide on a shared filesystem.
 from __future__ import annotations
 
 import inspect
+import io
 import json
 import logging
 import time
@@ -22,23 +23,44 @@ from typing import Optional
 import numpy as np
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
+from tf_yarn_tpu import fs as fs_lib
 
 _logger = logging.getLogger(__name__)
 
 
-def _call_input_fn(input_fn, shard: int, num_shards: int):
+def _accepts_sharding(input_fn) -> bool:
     try:
         params = inspect.signature(input_fn).parameters
     except (TypeError, ValueError):
         params = {}
-    if "shard" in params and "num_shards" in params:
-        return input_fn(shard=shard, num_shards=num_shards)
-    if num_shards > 1:
-        _logger.warning(
-            "input_fn takes no (shard, num_shards): every task instance "
-            "will process the FULL stream (duplicate outputs). Declare "
-            "the keywords to split it."
+    return "shard" in params and "num_shards" in params
+
+
+def _check_sharding_contract(input_fn, num_shards: int, allow_duplicate: bool):
+    """Launcher-level contract, not a warning: N instances silently
+    re-processing the full stream N times is the failure mode the
+    reference's topology validators exist to prevent. Checked BEFORE the
+    checkpoint restore so a misconfigured job fails in milliseconds, not
+    after minutes of weight loading."""
+    if _accepts_sharding(input_fn) or num_shards <= 1:
+        return
+    if not allow_duplicate:
+        raise ValueError(
+            f"{num_shards} inference instances but input_fn takes no "
+            "(shard, num_shards) keywords: every instance would process "
+            "the FULL stream and write duplicate records. Declare the "
+            "keywords to split the stream, or set "
+            "allow_duplicate_stream=True if duplication is intended."
         )
+    _logger.warning(
+        "input_fn takes no (shard, num_shards): every task instance "
+        "processes the FULL stream (allow_duplicate_stream=True)."
+    )
+
+
+def _call_input_fn(input_fn, shard: int, num_shards: int):
+    if _accepts_sharding(input_fn):
+        return input_fn(shard=shard, num_shards=num_shards)
     return input_fn()
 
 
@@ -69,6 +91,9 @@ def run_inference(experiment, runtime=None) -> dict:
         num_shards = sum(
             1 for ti in runtime.cluster_tasks if ti.key.type == runtime.task_key.type
         )
+    allow_duplicate = getattr(experiment, "allow_duplicate_stream", False)
+    _check_sharding_contract(experiment.input_fn, num_shards, allow_duplicate)
+    fs_lib.check_model_dir_placement(experiment.model_dir)
     variables, step = _restore_params(experiment.model_dir, experiment.step)
     _logger.info(
         "inference from ckpt-%d, shard %d/%d -> %s",
@@ -82,7 +107,9 @@ def run_inference(experiment, runtime=None) -> dict:
     records = batches = 0
     new_tokens = 0
     t0 = time.time()
-    with open(out_path, "w") as out:
+    # output_path may be any fs URI (gs://, hdfs://, ...) — results land
+    # where the fleet can read them, like every other model_dir artifact.
+    with io.TextIOWrapper(fs_lib.open_output(out_path), encoding="utf-8") as out:
         for batch in _call_input_fn(experiment.input_fn, shard, num_shards):
             tokens = np.asarray(batch["tokens"], np.int32)
             sequences = generate(

@@ -67,9 +67,11 @@ def zip_path(py_dir: str, include_base_name: bool = True) -> str:
 
 def _resolve_fs(target_dir: str, filesystem):
     if filesystem is None:
-        from pyarrow import fs as pafs
+        from tf_yarn_tpu import fs as fs_lib
 
-        filesystem, target_dir = pafs.FileSystem.from_uri(target_dir)
+        # Shares fs.register_scheme's vendor/test seam with every other
+        # URI consumer (checkpoints, markers, inference output).
+        filesystem, target_dir = fs_lib.resolve(target_dir)
     return filesystem, target_dir.rstrip("/")
 
 

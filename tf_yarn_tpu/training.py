@@ -34,7 +34,7 @@ import numpy as np
 import optax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
-from tf_yarn_tpu import event, preemption
+from tf_yarn_tpu import event, fs as fs_lib, preemption
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
@@ -323,13 +323,45 @@ def _make_input_iter(input_fn, start_step: int, logger):
     return iter(input_fn())
 
 
+class _UploadingTbWriter:
+    """SummaryWriter against a remote model_dir: write event files to a
+    local spool, upload the tree on close (the reference's TB-logs-to-fs
+    pattern, pytorch/tasks/worker.py:145-152)."""
+
+    def __init__(self, writer, spool_dir: str, target_uri: str):
+        self._writer = writer
+        self._spool_dir = spool_dir
+        self._target_uri = target_uri
+        self._closed = False
+
+    def add_scalar(self, *args, **kwargs):
+        self._writer.add_scalar(*args, **kwargs)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._writer.close()
+        try:
+            fs_lib.upload_dir(self._spool_dir, self._target_uri)
+        except Exception:
+            _logger.exception("TB log upload to %s failed", self._target_uri)
+
+
 def _make_tb_writer(model_dir: Optional[str]):
     if not model_dir:
         return None
     try:
         from torch.utils.tensorboard import SummaryWriter
 
-        return SummaryWriter(log_dir=f"{model_dir}/tb")
+        if fs_lib.is_local(model_dir):
+            return SummaryWriter(log_dir=f"{fs_lib.local_path(model_dir)}/tb")
+        import tempfile
+
+        spool = tempfile.mkdtemp(prefix="tpu-yarn-tb-")
+        return _UploadingTbWriter(
+            SummaryWriter(log_dir=spool), spool, fs_lib.join(model_dir, "tb")
+        )
     except Exception:  # tensorboard optional, as in the reference
         return None
 
@@ -365,6 +397,7 @@ def train_and_evaluate(
     # correct for stateless/synthetic streams, logged for the rest.
     input_resume_step = 0
     if core.model_dir:
+        fs_lib.check_model_dir_placement(core.model_dir)
         input_resume_step = ckpt_lib.latest_checkpoint_step(core.model_dir) or 0
     train_iter = _make_input_iter(
         core.train_input_fn, input_resume_step, _logger
@@ -517,6 +550,11 @@ def train_and_evaluate(
             peak_flops=flops_lib.peak_flops_per_chip(mesh.devices.flat[0]),
         )
         tb_writer = _make_tb_writer(core.model_dir)
+        if tb_writer is not None:
+            # On the cleanup stack, not just the happy path: for remote
+            # model_dirs close() is what uploads the spooled event files,
+            # and a crashed/preempted run must not lose them.
+            _cleanup.callback(tb_writer.close)
 
         metrics_host: Dict[str, float] = {}
         from tf_yarn_tpu.data.prefetch import prefetch
@@ -694,8 +732,8 @@ def train_and_evaluate(
                 params_cfg.eval_steps, train_rng,
             )
             metrics_host.update({f"eval_{k}": v for k, v in final_eval.items()})
-        if tb_writer is not None:
-            tb_writer.close()
+        # tb_writer closes (and, for remote model_dirs, uploads) via the
+        # _cleanup stack on both the happy and the exception path.
     return metrics_host
 
 

@@ -2,17 +2,18 @@
 
 Port of the reference (reference: pytorch/model_ckpt.py:15-77):
 `model_<epoch>.pt` files, latest-epoch discovery by regex, DDP unwrap on
-save. Filesystem-agnostic via open-fn injection (local by default; pass a
-pyarrow fs `open_input_stream`/`open_output_stream` pair for HDFS/GCS —
-the cluster_pack.filesystem role).
+save. `model_dir` may be any tf_yarn_tpu.fs URI (local path, gs://,
+hdfs://) — the cluster_pack.filesystem role the reference resolves at
+model_ckpt.py:31-44.
 """
 
 from __future__ import annotations
 
 import logging
-import os
 import re
 from typing import Any, Dict, Optional
+
+from tf_yarn_tpu import fs as fs_lib
 
 _logger = logging.getLogger(__name__)
 
@@ -25,15 +26,13 @@ def _unwrap(model):
 
 def find_latest_ckpt(model_dir: str) -> Optional[str]:
     """Newest model_<epoch>.pt in model_dir (reference: model_ckpt.py:15-28)."""
-    if not os.path.isdir(model_dir):
-        return None
     best: Optional[int] = None
-    for entry in os.listdir(model_dir):
+    for entry, _is_dir in fs_lib.listdir(model_dir):
         match = _CKPT_RE.match(entry)
         if match:
             epoch = int(match.group(1))
             best = epoch if best is None else max(best, epoch)
-    return os.path.join(model_dir, f"model_{best}.pt") if best is not None else None
+    return fs_lib.join(model_dir, f"model_{best}.pt") if best is not None else None
 
 
 def load_latest_ckpt(model_dir: str, device: str = "cpu") -> Optional[Dict[str, Any]]:
@@ -44,7 +43,7 @@ def load_latest_ckpt(model_dir: str, device: str = "cpu") -> Optional[Dict[str, 
     if path is None:
         _logger.info("no checkpoint found in %s", model_dir)
         return None
-    with open(path, "rb") as fh:
+    with fs_lib.open_input_file(path) as fh:
         return torch.load(fh, map_location=device, weights_only=False)
 
 
@@ -55,15 +54,14 @@ def save_ckpt(
     reference's usage)."""
     import torch
 
-    os.makedirs(model_dir, exist_ok=True)
     state = {
         "model": _unwrap(model).state_dict(),
         "optimizer": optimizer.state_dict(),
         "epoch": epoch,
         **kwargs,
     }
-    path = os.path.join(model_dir, f"model_{epoch}.pt")
-    with open(path, "wb") as fh:
+    path = fs_lib.join(model_dir, f"model_{epoch}.pt")
+    with fs_lib.open_output(path) as fh:
         torch.save(state, fh)
     _logger.info("saved checkpoint %s", path)
     return path

@@ -1,24 +1,36 @@
 """Benchmark: flagship training-step throughput on the local accelerator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N, ...}
 
 The reference publishes no numbers (BASELINE.md: "None"), so vs_baseline
 compares against the value recorded in BENCH_BASELINE.json when present
 (our own previous round), else 1.0. The full per-config suite lives in
 benchmarks/run.py.
 
-On TPU the bench also A/Bs the kernel knobs (attention_impl=xla|flash,
-fused_norms on/off), writes the table to BENCH_AB.json, and reports the
-*best* variant as the headline (the unit string names the winning impl).
+On TPU the bench A/Bs the kernel knobs (attention_impl=xla|flash,
+fused_norms on/off), adds decode (bf16 vs int8 KV cache) and long-context
+(S=8192) lines, and writes everything to BENCH_AB.json with measurement
+provenance (device, git commit, timestamp). The headline reports the
+*best* training variant (the unit string names the winning impl).
+
+When the accelerator is unreachable (a wedged relay can hang device init
+past any probe budget), the bench still reports the last committed TPU
+measurement from BENCH_AB.json as explicitly-labeled `last_tpu_*` fields
+next to the fresh CPU smoke number — honest staleness beats losing the
+hardware evidence (round-2 verdict item 1).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_AB_PATH = os.path.join(_REPO, "BENCH_AB.json")
 
 
 def _log(*args) -> None:
@@ -34,8 +46,6 @@ def _probe_backend_alive() -> bool:
     probing until TPU_YARN_BENCH_PROBE_BUDGET_S (default 900s) is spent,
     then degrade.
     """
-    import subprocess
-
     if os.environ.get("TPU_YARN_PLATFORM"):
         return True  # explicitly forced; nothing to probe
 
@@ -73,6 +83,90 @@ def _probe_backend_alive() -> bool:
         _log(f"retrying probe in {wait:.0f}s ({remaining:.0f}s budget left)")
         time.sleep(wait)
         backoff = min(backoff * 2, 240.0)
+
+
+def _git_head() -> str:
+    try:
+        return subprocess.run(
+            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:
+        return ""
+
+
+def _ab_file_provenance() -> dict:
+    """(commit, date) the committed BENCH_AB.json was last touched at —
+    the provenance trail for stale reporting when the file predates the
+    embedded measured_at/git_commit fields."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", _REPO, "log", "-1", "--format=%h|%cI", "--",
+             "BENCH_AB.json"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        commit, _, date = out.partition("|")
+        return {"git_commit": commit, "measured_at": date}
+    except Exception:
+        return {"git_commit": "", "measured_at": ""}
+
+
+def _stale_tpu_fields() -> dict:
+    """last_tpu_* fields from the committed A/B table, or {}."""
+    try:
+        with open(_AB_PATH) as fh:
+            table = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    rows = [r for r in table.get("rows", []) if "error" not in r]
+    if not rows:
+        return {}
+    best = max(rows, key=lambda r: r.get("samples_per_sec_per_chip", 0.0))
+    provenance = {
+        "git_commit": table.get("git_commit"),
+        "measured_at": table.get("measured_at"),
+    }
+    if not provenance["git_commit"]:
+        provenance = _ab_file_provenance()
+    fields = {
+        "last_tpu_value": best["samples_per_sec_per_chip"],
+        "last_tpu_mfu": best.get("mfu"),
+        "last_tpu_variant": best.get("variant"),
+        "last_tpu_device": table.get("device"),
+        "last_tpu_commit": provenance["git_commit"],
+        "last_tpu_date": provenance["measured_at"],
+    }
+    decode = table.get("decode") or {}
+    for key in ("decode_tokens_per_sec_bf16", "decode_tokens_per_sec_int8"):
+        if key in decode:
+            fields[f"last_tpu_{key}"] = decode[key]
+    longctx = table.get("long_context") or {}
+    if "tokens_per_sec_per_chip" in longctx:
+        fields["last_tpu_longctx_tokens_per_sec"] = longctx[
+            "tokens_per_sec_per_chip"
+        ]
+    return fields
+
+
+def _write_ab(table: dict) -> None:
+    try:
+        with open(_AB_PATH, "w") as fh:
+            json.dump(table, fh, indent=1)
+        _log(f"A/B table -> {_AB_PATH}")
+    except OSError as exc:
+        _log(f"could not write A/B table: {exc}")
+
+
+def _load_bench_suite():
+    """benchmarks/run.py as a module (no package __init__ there)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_yarn_bench_suite", os.path.join(_REPO, "benchmarks", "run.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
 
 
 def _run_variant(config, batch_size: int, seq_len: int, steps: int,
@@ -161,25 +255,15 @@ def bench_flagship_train():
     ok_rows = [r for r in table if "error" not in r]
     if not ok_rows:
         # Even a fully-failed sweep must emit the one JSON line.
-        return {
+        result = {
             "metric": "flagship_train_samples_per_sec_per_chip",
             "value": 0.0,
             "unit": "samples/sec/chip (all variants failed: "
             + "; ".join(str(r.get("error", ""))[:80] for r in table) + ")",
         }
+        result.update(_stale_tpu_fields())
+        return result
     best = max(ok_rows, key=lambda r: r["samples_per_sec_per_chip"])
-    if on_tpu:
-        ab_path = os.path.join(os.path.dirname(__file__), "BENCH_AB.json")
-        try:
-            with open(ab_path, "w") as fh:
-                json.dump({
-                    "config": {**base, "batch": batch_size, "seq": seq_len},
-                    "device": devices[0].device_kind,
-                    "rows": table,
-                }, fh, indent=1)
-            _log(f"A/B table -> {ab_path}")
-        except OSError as exc:
-            _log(f"could not write A/B table: {exc}")
 
     result = {
         "metric": "flagship_train_samples_per_sec_per_chip",
@@ -189,12 +273,84 @@ def bench_flagship_train():
     }
     if best.get("mfu") is not None:
         result["mfu"] = best["mfu"]
+
+    if not on_tpu:
+        # A wedged relay must not erase the hardware evidence: surface the
+        # committed TPU measurement with provenance, clearly staleness-
+        # labeled, next to the fresh CPU smoke number.
+        stale = _stale_tpu_fields()
+        if stale:
+            _log("attaching last-known TPU measurement "
+                 f"({stale.get('last_tpu_device')}, commit "
+                 f"{stale.get('last_tpu_commit')}, {stale.get('last_tpu_date')})")
+            result.update(stale)
+        return result
+
+    # --- TPU: persist the A/B table incrementally (flagship first, so a
+    # timeout mid-extras still leaves it recorded), then fold in decode
+    # and long-context — the driver artifact carries all three surfaces.
+    # Previous decode/long-context sections are carried forward with a
+    # staleness label until their fresh run succeeds: a failed extra must
+    # not erase the last hardware evidence for that surface.
+    try:
+        with open(_AB_PATH) as fh:
+            previous = json.load(fh)
+    except (OSError, ValueError):
+        previous = {}
+    ab = {
+        "config": {**base, "batch": batch_size, "seq": seq_len},
+        "device": devices[0].device_kind,
+        "git_commit": _git_head(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "rows": table,
+    }
+    for section in ("decode", "long_context"):
+        if previous.get(section):
+            ab[section] = {
+                **previous[section],
+                "stale_from_commit": previous.get("git_commit")
+                or _ab_file_provenance()["git_commit"],
+            }
+    _write_ab(ab)
+
+    suite = None
+    try:
+        suite = _load_bench_suite()
+    except Exception as exc:
+        _log(f"could not load benchmarks/run.py: {exc}")
+    if suite is not None:
+        try:
+            decode = suite.bench_decode(tpu=True)
+            ab["decode"] = decode
+            _write_ab(ab)
+            result["decode_tokens_per_sec_bf16"] = decode[
+                "decode_tokens_per_sec_bf16"]
+            result["decode_tokens_per_sec_int8"] = decode[
+                "decode_tokens_per_sec_int8"]
+            _log(f"decode: {decode}")
+        except Exception as exc:
+            _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
+        try:
+            longctx = suite.bench_long_context(tpu=True)
+            # Fresh measurement replaces any carried-forward stale section.
+            ab["long_context"] = {
+                key: longctx[key]
+                for key in ("tokens_per_sec_per_chip", "step_time_ms", "mfu")
+                if key in longctx
+            }
+            _write_ab(ab)
+            result["longctx_tokens_per_sec"] = longctx["tokens_per_sec_per_chip"]
+            if "mfu" in longctx:
+                result["longctx_mfu"] = longctx["mfu"]
+            _log(f"long_context: {ab['long_context']}")
+        except Exception as exc:
+            _log(f"long-context bench FAILED: {type(exc).__name__}: {exc}")
     return result
 
 
 def main() -> None:
     result = bench_flagship_train()
-    baseline_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    baseline_path = os.path.join(_REPO, "BENCH_BASELINE.json")
     vs_baseline = 1.0
     if os.path.exists(baseline_path):
         try:

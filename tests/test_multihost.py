@@ -98,7 +98,13 @@ def test_preemption_drain_agreed_across_hosts(tmp_path):
             optimizer=optax.adam(1e-2),
             loss_fn=common.classification_loss,
             train_input_fn=input_fn,
-            train_params=TrainParams(train_steps=10, log_every_steps=2),
+            train_params=TrainParams(
+                train_steps=10, log_every_steps=2,
+                # Explicit poll cadence: the drain must land on a multiple
+                # of 3 (asserted below), proving the agreement allgather is
+                # cadence-gated, not per-step.
+                drain_poll_every_steps=3,
+            ),
             mesh_spec=MeshSpec(dp=2),
             model_dir=model_dir,
         )
@@ -114,7 +120,10 @@ def test_preemption_drain_agreed_across_hosts(tmp_path):
 
     assert os.path.exists(marker), "preemption never injected"
     assert metrics.total_training_duration is not None
-    assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == 10
+    steps = ckpt_lib.list_checkpoint_steps(model_dir)
+    assert steps[-1] == 10
+    # The drain checkpoint sits on the poll cadence, not the flag step.
+    assert steps[0] % 3 == 0, steps
 
 
 def test_two_process_data_parallel_training(tmp_path):

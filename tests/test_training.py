@@ -87,6 +87,17 @@ def test_checkpoint_and_resume(tmp_path):
     assert ckpt_lib.latest_checkpoint_step(str(tmp_path)) == 25
 
 
+def test_drain_poll_cadence_validation():
+    # Single-host: the knob is inert (local flag reads), but bad values
+    # must still be rejected up front; the multi-host cadence behavior is
+    # covered end-to-end by test_multihost's drain test.
+    import pytest
+
+    core = _mnist_core(train_steps=6, drain_poll_every_steps=0)
+    with pytest.raises(ValueError, match="drain_poll_every_steps"):
+        train_and_evaluate(core, devices=select_devices(2, platform="cpu"))
+
+
 def test_input_fn_start_step_receives_resume_point(tmp_path):
     # Input resume seam: an input_fn declaring `start_step` is told where
     # training resumes so it can skip consumed data; one without the

@@ -60,6 +60,12 @@ class TrainParams:
     # still happens on its configured cadence: chunks never cross those
     # boundaries. Costs N staged batches of extra HBM.
     steps_per_loop: int = 1
+    # Multi-host preemption agreement (a device-pipeline drain + cross-host
+    # allgather) polls every N steps; None = the smallest host cadence
+    # above (log/checkpoint/eval). Lower = faster SIGTERM reaction, higher
+    # = less per-step sync overhead. Single-host polls are a flag read and
+    # ignore this. See docs/Performance.md "Preemption polling".
+    drain_poll_every_steps: Optional[int] = None
 
 
 @dataclasses.dataclass

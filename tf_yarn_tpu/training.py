@@ -270,9 +270,10 @@ def _preempt_agreed(state) -> bool:
     """Whether ALL hosts should drain now. SIGTERM delivery is per-host
     and skewed; a host draining alone would start a multi-host checkpoint
     save (a collective) its peers never join — deadlock until the grace
-    window's SIGKILL. Every host calls this at every host boundary (the
-    SPMD loop keeps boundaries in lockstep), so the allgather is safe and
-    the max makes one host's flag everyone's decision.
+    window's SIGKILL. Every host calls this on the same step cadence
+    (`drain_poll_every`; the SPMD loop keeps step counters in lockstep),
+    so the allgather is safe and the max makes one host's flag everyone's
+    decision.
 
     The block_until_ready is load-bearing: dispatched train steps are
     async, and posting the host-side allgather while a step's own
@@ -476,6 +477,33 @@ def train_and_evaluate(
                 params_cfg.eval_every_steps if core.eval_input_fn else None,
             ) if c
         ]
+        # Multi-host preemption agreement costs a pipeline drain + allgather
+        # (see _preempt_agreed) — polling it every step defeats async
+        # dispatch. Poll on a host-uniform cadence instead: the configured
+        # knob, else the smallest host cadence (those boundaries already
+        # surface to the host). Single-host keeps per-step flag checks
+        # (they're a local read, and reaction time matters under SIGTERM).
+        if (
+            params_cfg.drain_poll_every_steps is not None
+            and params_cfg.drain_poll_every_steps < 1
+        ):
+            raise ValueError(
+                f"drain_poll_every_steps={params_cfg.drain_poll_every_steps} "
+                "must be >= 1 (None = poll at the smallest host cadence)"
+            )
+        drain_poll_every = params_cfg.drain_poll_every_steps or min(host_cadences)
+        multi_host = jax.process_count() > 1
+        if multi_host and drain_poll_every >= params_cfg.train_steps:
+            _logger.warning(
+                "drain_poll_every_steps=%d >= train_steps=%d: preemption "
+                "is never polled mid-run; a SIGTERM will only be honored "
+                "by the grace-window SIGKILL",
+                drain_poll_every, params_cfg.train_steps,
+            )
+        if multi_host:
+            # steps_per_loop chunking must also stop at drain boundaries,
+            # or a chunk could step over the poll step entirely.
+            host_cadences.append(drain_poll_every)
         if steps_per_loop > 1:
             # Chunks never cross host boundaries (nor the end of the run),
             # so a longer chunk would simply never execute while still
@@ -643,6 +671,10 @@ def train_and_evaluate(
                 if (
                     not input_exhausted
                     and step < params_cfg.train_steps
+                    # Host-uniform poll cadence: every host computes the
+                    # same `step % drain_poll_every`, so either all post
+                    # the agreement allgather at this step or none do.
+                    and (not multi_host or step % drain_poll_every == 0)
                     and _preempt_agreed(state)
                 ):
                     # First thing at the host boundary — before eval/log

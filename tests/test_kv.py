@@ -156,3 +156,47 @@ def test_many_concurrent_waiters(kv):
     for t in threads:
         t.join(timeout=10.0)
     assert results == {i: f"v{i}".encode() for i in range(12)}
+
+
+def test_pooled_read_timeout_does_not_hang():
+    # A server that accepts connections but never replies: a bounded
+    # pooled read must surface an error instead of parking the client
+    # forever (a worker stuck here would never reach the preemption
+    # drain poll — ADVICE r2).
+    import socket as socket_mod
+
+    from tf_yarn_tpu.coordination.kv import KVClient
+
+    silent = socket_mod.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(4)
+    host, port = silent.getsockname()
+    try:
+        client = KVClient(f"{host}:{port}", read_timeout=1.0)
+        t0 = time.time()
+        with pytest.raises(OSError):
+            client.get("anything")
+        # One timeout + one idempotent retry, both bounded.
+        assert time.time() - t0 < 10.0
+        client.close()
+    finally:
+        silent.close()
+
+
+def test_keepalive_enabled_on_pooled_socket():
+    import socket as socket_mod
+
+    from tf_yarn_tpu.coordination.kv import KVClient, start_server
+
+    server = start_server()
+    try:
+        client = KVClient(server.endpoint)
+        client.get("whatever")  # force the pooled connection open
+        sock = client._sock
+        assert sock is not None
+        assert (
+            sock.getsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_KEEPALIVE) == 1
+        )
+        client.close()
+    finally:
+        server.stop()

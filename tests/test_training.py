@@ -88,14 +88,13 @@ def test_checkpoint_and_resume(tmp_path):
 
 
 def test_drain_poll_cadence_validation():
-    # Single-host: the knob is inert (local flag reads), but bad values
-    # must still be rejected up front; the multi-host cadence behavior is
-    # covered end-to-end by test_multihost's drain test.
+    # Bad values are rejected at TrainParams construction — before any
+    # restore/compile work; the multi-host cadence behavior is covered
+    # end-to-end by test_multihost's drain test.
     import pytest
 
-    core = _mnist_core(train_steps=6, drain_poll_every_steps=0)
     with pytest.raises(ValueError, match="drain_poll_every_steps"):
-        train_and_evaluate(core, devices=select_devices(2, platform="cpu"))
+        _mnist_core(train_steps=6, drain_poll_every_steps=0)
 
 
 def test_input_fn_start_step_receives_resume_point(tmp_path):

@@ -38,6 +38,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import os
 import socket
 import socketserver
 import struct
@@ -286,12 +287,42 @@ class KVClient(KVStore):
     event-log entries, double-incremented rank tickets).
     """
 
-    def __init__(self, endpoint: str, connect_timeout: float = 30.0) -> None:
+    def __init__(
+        self,
+        endpoint: str,
+        connect_timeout: float = 30.0,
+        read_timeout: Optional[float] = None,
+    ) -> None:
         host, _, port = endpoint.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._connect_timeout = connect_timeout
+        # Reply-wait bound for the idempotent pooled reads (retried once on
+        # a fresh connection, so a bounded timeout is safe for them —
+        # unlike mutations). Generous default: it only needs to beat a
+        # silent network partition, not a busy server.
+        if read_timeout is None:
+            read_timeout = float(
+                os.environ.get("TPU_YARN_KV_READ_TIMEOUT", "300")
+            )
+        self._read_timeout = read_timeout if read_timeout > 0 else None
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            self._addr, timeout=self._connect_timeout
+        )
+        # Keepalive on every connection: mutations and waits keep unbounded
+        # reply waits (see _request), so a silently-dead peer must
+        # eventually surface as ECONNRESET via probe failures instead of
+        # hanging the caller forever.
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        for opt, val in (
+            ("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 30), ("TCP_KEEPCNT", 6),
+        ):
+            if hasattr(socket, opt):  # linux; other platforms keep defaults
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+        return sock
 
     @property
     def endpoint(self) -> str:
@@ -321,9 +352,7 @@ class KVClient(KVStore):
             # `wait` may block server-side until the key appears (socket
             # timeout must outlive it); mutations must be at-most-once, so
             # no pooled-socket reuse/retry for them either.
-            sock = socket.create_connection(
-                self._addr, timeout=self._connect_timeout
-            )
+            sock = self._connect()
             try:
                 if op == "wait":
                     # Must outlive the server-side wait (None = unbounded).
@@ -343,20 +372,22 @@ class KVClient(KVStore):
                 reply = None
                 for attempt in (0, 1):
                     if self._sock is None:
-                        self._sock = socket.create_connection(
-                            self._addr, timeout=self._connect_timeout
-                        )
-                        # Connect is bounded; reply waits are not (pre-
-                        # pooling semantics): reads must ride out a server
-                        # stalled mid-checkpoint rather than timing out.
-                        self._sock.settimeout(None)
+                        self._sock = self._connect()
+                        # Reads are idempotent and retried once, so a
+                        # bounded reply wait is safe for them — a
+                        # stalled-but-connected server or silent partition
+                        # must not park a worker here forever (a worker
+                        # stuck in a KV read never reaches the preemption
+                        # drain poll). socket.timeout is an OSError:
+                        # handled by the drop-and-retry below.
+                        self._sock.settimeout(self._read_timeout)
                     try:
                         reply = self._roundtrip(self._sock, req)
                         break
                     except (ConnectionError, OSError):
                         # Stale pooled socket (server restart, idle
-                        # reset): drop it; these ops are idempotent, so
-                        # retry once on a fresh connection.
+                        # reset) or read timeout: drop it; these ops are
+                        # idempotent, so retry once on a fresh connection.
                         self._drop_pooled_locked()
                         if attempt:
                             raise

@@ -483,14 +483,8 @@ def train_and_evaluate(
         # knob, else the smallest host cadence (those boundaries already
         # surface to the host). Single-host keeps per-step flag checks
         # (they're a local read, and reaction time matters under SIGTERM).
-        if (
-            params_cfg.drain_poll_every_steps is not None
-            and params_cfg.drain_poll_every_steps < 1
-        ):
-            raise ValueError(
-                f"drain_poll_every_steps={params_cfg.drain_poll_every_steps} "
-                "must be >= 1 (None = poll at the smallest host cadence)"
-            )
+        # Range validation lives in TrainParams.__post_init__ (fail at
+        # construction, before restore/compile).
         drain_poll_every = params_cfg.drain_poll_every_steps or min(host_cadences)
         multi_host = jax.process_count() > 1
         if multi_host and drain_poll_every >= params_cfg.train_steps:

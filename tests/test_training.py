@@ -95,6 +95,40 @@ def test_drain_poll_cadence_validation():
 
     with pytest.raises(ValueError, match="drain_poll_every_steps"):
         _mnist_core(train_steps=6, drain_poll_every_steps=0)
+    # Negative values matter independently of 0: at runtime 0 would be
+    # masked by a default-fallback while a negative cadence flows into
+    # `step % cadence` and silently disables the SIGTERM drain.
+    with pytest.raises(ValueError, match="drain_poll_every_steps"):
+        _mnist_core(train_steps=6, drain_poll_every_steps=-3)
+
+
+def test_train_params_validation():
+    from tf_yarn_tpu.experiment import TrainParams
+
+    import pytest
+
+    # The silent-nonsense class: each knob rejects values that would
+    # otherwise produce a loop that never logs/checkpoints/evals or a
+    # ZeroDivisionError deep inside the jitted path.
+    with pytest.raises(ValueError, match="train_steps"):
+        TrainParams(train_steps=0)
+    with pytest.raises(ValueError, match="steps_per_loop"):
+        TrainParams(train_steps=5, steps_per_loop=0)
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        TrainParams(train_steps=5, grad_accum_steps=-1)
+    with pytest.raises(ValueError, match="eval_every_steps"):
+        TrainParams(train_steps=5, eval_every_steps=0)
+    with pytest.raises(ValueError, match="checkpoint_every_steps"):
+        TrainParams(train_steps=5, checkpoint_every_steps=-2)
+    with pytest.raises(ValueError, match="keep_last_n"):
+        TrainParams(train_steps=5, keep_last_n=0)
+    with pytest.raises(ValueError, match="eval_steps"):
+        TrainParams(train_steps=5, eval_steps=0)
+    with pytest.raises(ValueError, match="log_every_steps"):
+        TrainParams(train_steps=5, log_every_steps=-1)
+    # log_every_steps=0 is valid: "never log" (and the drain fallback
+    # copes with an empty host-cadence set by polling every step).
+    TrainParams(train_steps=5, log_every_steps=0)
 
 
 def test_input_fn_start_step_receives_resume_point(tmp_path):

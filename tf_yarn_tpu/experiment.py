@@ -67,6 +67,38 @@ class TrainParams:
     # ignore this. See docs/Performance.md "Preemption polling".
     drain_poll_every_steps: Optional[int] = None
 
+    def __post_init__(self) -> None:
+        # Fail at construction, before any restore/compile work — the
+        # reference's validator posture (topologies validate task specs at
+        # build time, /root/reference/tf_yarn/topologies.py:97-128). A
+        # value of 0 would otherwise be masked by an `or`-fallback and a
+        # negative one would silently disable the SIGTERM drain poll.
+        if self.train_steps < 1:
+            raise ValueError(
+                f"train_steps must be >= 1, got {self.train_steps}")
+        if self.steps_per_loop < 1:
+            raise ValueError(
+                f"steps_per_loop must be >= 1, got {self.steps_per_loop}")
+        if self.grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1, got {self.grad_accum_steps}")
+        if (self.drain_poll_every_steps is not None
+                and self.drain_poll_every_steps < 1):
+            raise ValueError(
+                "drain_poll_every_steps must be >= 1, got "
+                f"{self.drain_poll_every_steps}")
+        for name in ("eval_every_steps", "checkpoint_every_steps",
+                     "keep_last_n"):
+            value = getattr(self, name)
+            if value is not None and value < 1:
+                raise ValueError(f"{name} must be >= 1, got {value}")
+        if self.log_every_steps < 0:
+            raise ValueError(
+                f"log_every_steps must be >= 0, got {self.log_every_steps}")
+        if self.eval_steps < 1:
+            raise ValueError(
+                f"eval_steps must be >= 1, got {self.eval_steps}")
+
 
 @dataclasses.dataclass
 class JaxExperiment:

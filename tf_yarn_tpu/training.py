@@ -326,8 +326,11 @@ def _make_input_iter(input_fn, start_step: int, logger):
 
 class _UploadingTbWriter:
     """SummaryWriter against a remote model_dir: write event files to a
-    local spool, upload the tree on close (the reference's TB-logs-to-fs
-    pattern, pytorch/tasks/worker.py:145-152)."""
+    local spool, upload the tree incrementally at checkpoint boundaries
+    and finally on close (the reference's TB-logs-to-fs pattern,
+    pytorch/tasks/worker.py:145-152). Everything except the upload
+    lifecycle delegates to the wrapped writer, so user hooks holding the
+    writer can call add_histogram/add_text/... unchanged."""
 
     def __init__(self, writer, spool_dir: str, target_uri: str):
         self._writer = writer
@@ -335,8 +338,21 @@ class _UploadingTbWriter:
         self._target_uri = target_uri
         self._closed = False
 
-    def add_scalar(self, *args, **kwargs):
-        self._writer.add_scalar(*args, **kwargs)
+    def __getattr__(self, name):
+        # Only reached when normal lookup fails — i.e. every SummaryWriter
+        # method we don't wrap (add_histogram, add_text, flush, ...).
+        return getattr(self._writer, name)
+
+    def upload(self):
+        """Push the spool to the remote dir now. Called at checkpoint
+        boundaries so a SIGKILL costs at most one checkpoint interval of
+        TB events, not the whole run. Event files are append-only, so
+        re-copying the tree is idempotent."""
+        self._writer.flush()
+        try:
+            fs_lib.upload_dir(self._spool_dir, self._target_uri)
+        except Exception:
+            _logger.exception("TB log upload to %s failed", self._target_uri)
 
     def close(self):
         if self._closed:
@@ -484,8 +500,14 @@ def train_and_evaluate(
         # surface to the host). Single-host keeps per-step flag checks
         # (they're a local read, and reaction time matters under SIGTERM).
         # Range validation lives in TrainParams.__post_init__ (fail at
-        # construction, before restore/compile).
-        drain_poll_every = params_cfg.drain_poll_every_steps or min(host_cadences)
+        # construction, before restore/compile). With no configured knob
+        # and no host cadences at all (log_every_steps=0, no model_dir,
+        # no eval) there is no natural poll boundary — fall back to
+        # polling every step rather than crash or never poll.
+        if params_cfg.drain_poll_every_steps is not None:
+            drain_poll_every = params_cfg.drain_poll_every_steps
+        else:
+            drain_poll_every = min(host_cadences, default=1)
         multi_host = jax.process_count() > 1
         if multi_host and drain_poll_every >= params_cfg.train_steps:
             _logger.warning(
@@ -711,6 +733,10 @@ def train_and_evaluate(
                     and core.model_dir
                 ):
                     ckpt_writer.save(core.model_dir, step, state)
+                    if isinstance(tb_writer, _UploadingTbWriter):
+                        # TB events survive a SIGKILL up to the last
+                        # checkpoint boundary, like the model state does.
+                        tb_writer.upload()
                 if (
                     params_cfg.eval_every_steps
                     and core.eval_input_fn

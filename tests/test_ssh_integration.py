@@ -71,6 +71,102 @@ def test_run_on_tpu_over_ssh_with_files(tmp_path):
     assert shipped == ["worker-0", "worker-1"]
 
 
+def _bare_ssh(tmp_path):
+    """Like _fake_ssh but the remote shell starts in the fake HOME, so the
+    driver's checkout is NOT on the implicit sys.path (python -m prepends
+    cwd): the worker is a genuinely bare interpreter — image deps in
+    site-packages, no tf_yarn_tpu importable until env shipping lands it."""
+    fake_home = tmp_path / "remote_home"
+    fake_home.mkdir(exist_ok=True)
+    shim = tmp_path / "bare_ssh"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f'export HOME="{fake_home}"\n'
+        'cd "$HOME"\n'
+        'exec /bin/sh -c "$2"\n'
+    )
+    shim.chmod(0o755)
+    return str(shim), fake_home
+
+
+def _make_shipped_code_experiment_fn(home: str):
+    """Build the experiment closure INSIDE a function call so cloudpickle
+    serializes it by value — the whole point is that `test_ssh_integration`
+    is not importable on the bare worker."""
+
+    def experiment_fn():
+        def run(params):
+            import os as _os
+
+            import tf_yarn_tpu as pkg
+
+            # The import must come from the shipped copy under the remote
+            # HOME — not the driver's checkout.
+            path = _os.path.abspath(pkg.__file__)
+            assert path.startswith(home), (
+                f"imported {path}, expected under {home}")
+            print(f"rank {params.rank} imported shipped copy: {path}")
+        return run
+
+    return experiment_fn
+
+
+def test_env_ships_over_backend_channel_to_bare_worker(tmp_path):
+    # VERDICT r3 item 2: no remote_prefix, no pre-provisioned package —
+    # the code travels through the backend's own file channel
+    # (packaging.ship_files, the zero-config default for remote backends).
+    shim, fake_home = _bare_ssh(tmp_path)
+    backend = SshBackend(
+        hosts=[TpuVmHost("vm-0", 0), TpuVmHost("vm-1", 1)],
+        python=sys.executable,
+        ssh_cmd=[shim],
+    )
+    home = str(fake_home)
+    metrics = run_on_tpu(
+        _make_shipped_code_experiment_fn(home),
+        {"worker": TaskSpec(instances=2)},
+        backend=backend,
+        custom_task_module="tf_yarn_tpu.tasks.distributed",
+        env={"TPU_YARN_COORDD": "python"},
+        poll_every_secs=0.2,
+        timeout_secs=180,
+    )
+    assert metrics is not None
+    assert set(metrics.container_duration) == {"worker:0", "worker:1"}
+    shipped = list((fake_home / ".tpu_yarn_runs").rglob("tf_yarn_tpu/client.py"))
+    assert len(shipped) == 2  # one shipped copy per task workdir
+
+
+def test_env_ships_via_staging_dir_to_bare_worker(tmp_path):
+    # The reference's upload_env path (client.py:421-424): zip -> upload
+    # to a shared-fs staging dir -> pre_script_hook fetches + unpacks +
+    # extends PYTHONPATH before the task module starts.
+    shim, fake_home = _bare_ssh(tmp_path)
+    staging = tmp_path / "staging"  # stands in for gs://... / NFS
+    backend = SshBackend(
+        hosts=[TpuVmHost("vm-0", 0)],
+        python=sys.executable,
+        ssh_cmd=[shim],
+    )
+    home = str(fake_home)
+    metrics = run_on_tpu(
+        _make_shipped_code_experiment_fn(home),
+        {"worker": TaskSpec(instances=1)},
+        backend=backend,
+        custom_task_module="tf_yarn_tpu.tasks.distributed",
+        env_staging_dir=str(staging),
+        env={"TPU_YARN_COORDD": "python"},
+        poll_every_secs=0.2,
+        timeout_secs=180,
+    )
+    assert metrics is not None
+    # The archive was staged (content-addressed zip) and unpacked under
+    # the worker's HOME.
+    assert any(p.suffix == ".zip" for p in staging.iterdir())
+    unpacked = list((fake_home / ".tpu_yarn_code").rglob("tf_yarn_tpu/client.py"))
+    assert len(unpacked) == 1
+
+
 def test_run_on_tpu_over_ssh_failure_propagates(tmp_path):
     shim, _ = _fake_ssh(tmp_path)
 

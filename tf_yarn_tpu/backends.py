@@ -190,11 +190,17 @@ class LocalBackend(SliceBackend):
                         log_dir, f"{task_type}-{task_id}-files"
                     )
                     os.makedirs(workdir, exist_ok=True)
+                    ignore = shutil.ignore_patterns(
+                        "__pycache__", "*.pyc", ".git", ".pytest_cache",
+                        "node_modules",
+                    )
                     for name, src in spec.files.items():
                         dst = os.path.join(workdir, name)
                         os.makedirs(os.path.dirname(dst), exist_ok=True)
                         if os.path.isdir(src):
-                            shutil.copytree(src, dst, dirs_exist_ok=True)
+                            shutil.copytree(
+                                src, dst, dirs_exist_ok=True, ignore=ignore
+                            )
                         else:
                             shutil.copy(src, dst)
                     # cwd moves to the workdir; keep the driver's cwd
@@ -286,15 +292,25 @@ class SshBackend(SliceBackend):
 
     @staticmethod
     def _pack_files(files: Dict[str, str]) -> str:
-        """Tar `name -> local path` entries into a temp archive."""
+        """Tar `name -> local path` entries into a temp archive. Cache and
+        VCS trees are pruned (the env-shipping default includes whole
+        package dirs; __pycache__/.git must not ride to every VM)."""
         import tarfile
         import tempfile
+
+        skip = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+        def _filter(info):
+            parts = info.name.split("/")
+            if any(p in skip for p in parts) or info.name.endswith(".pyc"):
+                return None
+            return info
 
         fd, tar_path = tempfile.mkstemp(suffix=".tar.gz", prefix="tpu_yarn_files-")
         os.close(fd)
         with tarfile.open(tar_path, "w:gz") as tar:
             for name, src in files.items():
-                tar.add(src, arcname=name)
+                tar.add(src, arcname=name, filter=_filter)
         return tar_path
 
     def _ship_files(self, hostname: str, tar_path: str, remote_dir: str) -> None:

@@ -388,6 +388,8 @@ def run_on_tpu(
     env: Optional[Dict[str, str]] = None,
     files: Optional[Dict[str, str]] = None,
     pre_script_hook: str = "",
+    env_staging_dir: Optional[str] = None,
+    ship_code: Optional[bool] = None,
     nb_retries: int = 0,
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
@@ -403,6 +405,16 @@ def run_on_tpu(
     module, a function of local_rank). It is cloudpickled to every task;
     use :func:`get_safe_experiment_fn` when the closure must not capture
     the driver's module state.
+
+    Environment shipping (the reference always ships the interpreter env,
+    client.py:421-424): with a remote backend the project code travels to
+    every worker automatically — via `packaging.ship_env` staged on
+    `env_staging_dir` when given (a URI every worker can read: gs://,
+    hdfs://, an NFS path), else streamed over the backend's own file
+    channel (`packaging.ship_files`, no shared filesystem needed). Workers
+    need only a bare interpreter + the deps baked into the TPU VM image.
+    `ship_code=False` opts out (code pre-provisioned via `remote_prefix`);
+    `ship_code=True` forces shipping even on a local backend.
     """
     task_specs = dict(task_specs) if task_specs else single_server_topology()
     check_topology(task_specs)
@@ -412,6 +424,21 @@ def run_on_tpu(
         # and advertise a routable address (ADVICE r1).
         coordinator_bind = "0.0.0.0"
     env = dict(env or {})
+    files = dict(files or {})
+    if ship_code is None:
+        ship_code = getattr(backend, "is_remote", True)
+    if ship_code:
+        from tf_yarn_tpu import packaging
+
+        if env_staging_dir is not None:
+            ship_hook = packaging.ship_env(env_staging_dir)
+            pre_script_hook = (
+                f"{ship_hook} && {pre_script_hook}" if pre_script_hook
+                else ship_hook
+            )
+        else:
+            for ship_name, ship_src in packaging.ship_files().items():
+                files.setdefault(ship_name, ship_src)
     serialized_fn = cloudpickle.dumps(experiment_fn)
 
     n_try = 0

@@ -40,12 +40,12 @@ def register_scheme(scheme: str, factory: Callable[[str], Tuple[object, str]]) -
     that need a fake remote fs (e.g. a SubTreeFileSystem over a temp dir).
     """
     _REGISTRY[scheme] = factory
-    _resolve_remote.cache_clear()
+    _fs_for_root.cache_clear()
 
 
 def unregister_scheme(scheme: str) -> None:
     _REGISTRY.pop(scheme, None)
-    _resolve_remote.cache_clear()
+    _fs_for_root.cache_clear()
 
 
 def parse_scheme(uri: str) -> str:
@@ -70,18 +70,41 @@ def local_path(uri: str) -> str:
     raise ValueError(f"{uri!r} is not a local path")
 
 
-@functools.lru_cache(maxsize=256)
-def _resolve_remote(uri: str):
-    """Cached remote resolution: polling loops hit the same uris every
-    interval, and constructing a fresh HadoopFileSystem/GcsFileSystem per
-    call would open a new client connection each time (pyarrow
-    filesystems are thread-safe, so sharing is sound)."""
+def _split_root(uri: str) -> Tuple[str, str]:
+    """"hdfs://host:port/a/b" -> ("hdfs://host:port/", "a/b").
+
+    The root identifies the filesystem *client* (scheme + authority);
+    the remainder is a path within it. Caching clients per root instead
+    of per full URI keeps connection reuse across a long run where every
+    checkpoint step resolves a distinct `.../ckpt-<step>` URI."""
+    scheme = parse_scheme(uri)
+    rest = uri[len(scheme) + 3:]
+    authority, _, path = rest.partition("/")
+    return f"{scheme}://{authority}/", path
+
+
+@functools.lru_cache(maxsize=64)
+def _fs_for_root(root_uri: str):
+    """One pyarrow filesystem client per (scheme, authority) — a fresh
+    HadoopFileSystem/GcsFileSystem per call would open a new connection
+    each time (pyarrow filesystems are thread-safe, so sharing is sound)."""
     from pyarrow import fs as pafs
 
+    return pafs.FileSystem.from_uri(root_uri)
+
+
+def _resolve_remote(uri: str):
     scheme = parse_scheme(uri)
     if scheme in _REGISTRY:
+        # Registered factories may derive the path from the full URI
+        # arbitrarily, so they are consulted per call; a vendor factory
+        # doing expensive construction should cache internally.
         return _REGISTRY[scheme](uri)
-    return pafs.FileSystem.from_uri(uri)
+    root, path = _split_root(uri)
+    filesystem, base = _fs_for_root(root)
+    if path:
+        return filesystem, base.rstrip("/") + "/" + path
+    return filesystem, base
 
 
 def resolve(uri: str):
@@ -187,9 +210,17 @@ def read_text(uri: str) -> str:
         return stream.read().decode("utf-8")
 
 
-def upload_dir(local_dir: str, uri: str) -> int:
-    """Recursively copy a local tree to `uri`; returns files copied."""
-    filesystem, target = resolve(uri)
+def upload_dir(local_dir: str, uri: str, filesystem=None) -> int:
+    """Recursively copy a local tree to `uri`; returns files copied.
+
+    The single walk-and-copy implementation — `packaging.upload_dir`
+    delegates here (one bug surface for remote-fs copies). An explicit
+    `filesystem` skips URI resolution and treats `uri` as a path within
+    it."""
+    if filesystem is None:
+        filesystem, target = resolve(uri)
+    else:
+        target = uri.rstrip("/")
     copied = 0
     for root, _dirs, files in os.walk(local_dir):
         rel_root = os.path.relpath(root, local_dir)

@@ -103,22 +103,13 @@ def upload_env(
 def upload_dir(local_dir: str, target_dir: str, filesystem=None) -> int:
     """Recursively copy a local directory tree onto a pyarrow filesystem
     (reference uploads TB logs this way, pytorch/tasks/worker.py:145-152).
-    Returns the number of files copied."""
+    Returns the number of files copied. Delegates to `fs.upload_dir` —
+    one walk-and-copy implementation for the whole repo."""
+    from tf_yarn_tpu import fs as fs_lib
+
     if not os.path.isdir(local_dir):
         raise ValueError(f"upload_dir: {local_dir!r} is not a directory")
-    filesystem, target_dir = _resolve_fs(target_dir, filesystem)
-    copied = 0
-    for root, _dirs, files in os.walk(local_dir):
-        rel_root = os.path.relpath(root, local_dir)
-        remote_root = (
-            target_dir if rel_root == "." else f"{target_dir}/{rel_root}"
-        )
-        filesystem.create_dir(remote_root, recursive=True)
-        for name in files:
-            _copy_file_to_fs(
-                os.path.join(root, name), filesystem, f"{remote_root}/{name}"
-            )
-            copied += 1
+    copied = fs_lib.upload_dir(local_dir, target_dir, filesystem=filesystem)
     _logger.info("uploaded %d files %s -> %s", copied, local_dir, target_dir)
     return copied
 
@@ -161,14 +152,146 @@ def detect_packed_repo() -> Optional[str]:
     return os.path.dirname(os.path.dirname(os.path.abspath(tf_yarn_tpu.__file__)))
 
 
-def unpack_cmd(remote_zip: str, dest: str = "~/.tpu_yarn_code") -> str:
-    """Shell one-liner for SshBackend.pre_script_hook: fetch + unzip +
-    prepend to PYTHONPATH on the TPU VM."""
-    return (
-        f"mkdir -p {dest} && python3 -c \"import zipfile,sys;"
-        f"zipfile.ZipFile('{remote_zip}').extractall('{dest}')\" && "
-        f"export PYTHONPATH={dest}:$PYTHONPATH"
+_SHELL_SAFE_RE = None
+
+
+def _require_shell_safe(value: str, what: str) -> str:
+    """unpack_cmd interpolates paths into a worker-side shell line AND a
+    single-quoted python literal; rather than attempt dual-context
+    quoting (where `~` expansion and `$HOME` must still work), reject
+    anything outside the conservative safe set with a clear error."""
+    global _SHELL_SAFE_RE
+    if _SHELL_SAFE_RE is None:
+        import re
+
+        _SHELL_SAFE_RE = re.compile(r"^[A-Za-z0-9_./:~=@%+-]+$")
+    if not _SHELL_SAFE_RE.match(value):
+        raise ValueError(
+            f"{what} {value!r} contains shell-unsafe characters "
+            "(spaces/quotes/metacharacters); use a path matching "
+            "[A-Za-z0-9_./:~=@%+-]"
+        )
+    return value
+
+
+def _fetch_cmd(remote_zip: str, local_zip: str) -> Optional[str]:
+    """Shell command fetching `remote_zip` to a worker-local path, or None
+    when the zip is directly readable (plain path / file:// — a shared
+    mount). Only stdlib + the scheme's own CLI are assumed on the worker:
+    env shipping exists precisely because tf_yarn_tpu is NOT importable
+    there yet."""
+    from tf_yarn_tpu import fs as fs_lib
+
+    scheme = fs_lib.parse_scheme(remote_zip)
+    if scheme in ("", "file"):
+        return None
+    if scheme == "gs":
+        return f"gsutil -q cp {remote_zip} {local_zip}"
+    if scheme in ("hdfs", "viewfs"):
+        return f"hdfs dfs -get -f {remote_zip} {local_zip}"
+    raise ValueError(
+        f"no worker-side fetch command for scheme {scheme!r} "
+        f"({remote_zip}); stage the env on a path, file://, gs://, or "
+        "hdfs:// filesystem — or ship over the backend channel instead "
+        "(run_on_tpu without env_staging_dir)"
     )
+
+
+def unpack_cmd(
+    remote_zip: str,
+    dest: str = "~/.tpu_yarn_code",
+    export_pythonpath: bool = True,
+) -> str:
+    """Shell one-liner for SshBackend.pre_script_hook: fetch + unzip +
+    prepend to PYTHONPATH on the TPU VM. Assumes only a bare python3
+    (zipfile is stdlib); `~` is expanded on the worker, not the driver."""
+    from tf_yarn_tpu import fs as fs_lib
+
+    if fs_lib.parse_scheme(remote_zip) == "file":
+        remote_zip = remote_zip[len("file://"):]
+    _require_shell_safe(remote_zip, "remote_zip")
+    _require_shell_safe(dest, "dest")
+    fetch = _fetch_cmd(remote_zip, f"{dest}/_fetched.zip")
+    src = f"{dest}/_fetched.zip" if fetch else remote_zip
+    parts = [f"mkdir -p {dest}"]
+    if fetch:
+        parts.append(fetch)
+    # expanduser runs worker-side so `~` paths work from inside python
+    # (the shell only expands `~` at a word start, not mid-argument).
+    parts.append(
+        "python3 -c \"import os,zipfile;"
+        f"zipfile.ZipFile(os.path.expanduser('{src}'))"
+        f".extractall(os.path.expanduser('{dest}'))\""
+    )
+    if export_pythonpath:
+        parts.append(f"export PYTHONPATH={dest}:$PYTHONPATH")
+    return " && ".join(parts)
+
+
+def package_dir() -> str:
+    """The importable tf_yarn_tpu package directory (what a worker needs
+    on its PYTHONPATH)."""
+    import tf_yarn_tpu
+
+    return os.path.dirname(os.path.abspath(tf_yarn_tpu.__file__))
+
+
+def ship_env(
+    staging_dir: str,
+    dest: str = "~/.tpu_yarn_code",
+    include_editable: bool = True,
+) -> str:
+    """Zip + upload this environment's project code and return the
+    pre_script_hook that bootstraps it on a bare-interpreter worker.
+
+    The reference ships the full interpreter env on every run
+    (reference: client.py:421-424 auto `cluster_pack.upload_env`,
+    packaging.py:39-56). TPU VMs are provisioned from images that already
+    carry python+jax, so what must travel is the *project* code:
+    tf_yarn_tpu itself plus any pip-editable working copies. Archives are
+    content-addressed (`zip_path`), so re-runs re-upload only on change.
+    """
+    # tf_yarn_tpu itself is zipped with its base name so `dest` becomes
+    # the sys.path root containing the package; each editable pth entry
+    # is already a sys.path root, so its contents extract flat.
+    archives = [zip_path(package_dir(), include_base_name=True)]
+    if include_editable:
+        for _name, src_dir in sorted(get_editable_requirements().items()):
+            archives.append(zip_path(src_dir, include_base_name=False))
+    # Content-addressed unpack dir: same code re-extracts into the same
+    # place, changed code gets a fresh one — a deleted module can't
+    # linger from a previous run's extraction.
+    digest = hashlib.sha256(
+        "|".join(os.path.basename(a) for a in archives).encode()
+    ).hexdigest()[:12]
+    unpack_root = f"{dest.rstrip('/')}/{digest}"
+    hooks = [
+        unpack_cmd(upload_env(a, staging_dir), unpack_root,
+                   export_pythonpath=False)
+        for a in archives
+    ]
+    hooks.append(f"export PYTHONPATH={unpack_root}:$PYTHONPATH")
+    return " && ".join(hooks)
+
+
+def ship_files() -> Dict[str, str]:
+    """Project code as `files=` entries for the backend channel (SshBackend
+    streams these over ssh into each task's workdir, which lands on
+    PYTHONPATH) — env shipping with no shared filesystem at all. The
+    zero-config default for remote backends; `ship_env` is the
+    shared-staging alternative."""
+    entries: Dict[str, str] = {"tf_yarn_tpu": package_dir()}
+    for _name, src_dir in sorted(get_editable_requirements().items()):
+        # A pth entry is a sys.path root: ship each child so the workdir
+        # itself is the import root — minus VCS/cache trees (a flat-layout
+        # checkout has .git/ and friends as children; streaming gigabytes
+        # of history to every TPU VM on every run is the bug, zip_path
+        # prunes the same set).
+        for child in sorted(os.listdir(src_dir)):
+            if child in _EXCLUDE_DIRS:
+                continue
+            entries.setdefault(child, os.path.join(src_dir, child))
+    return entries
 
 
 def python_env_description() -> Dict[str, str]:

@@ -126,6 +126,80 @@ def test_preemption_drain_agreed_across_hosts(tmp_path):
     assert steps[0] % 3 == 0, steps
 
 
+def _staged_remote_experiment_fn(remote_base: str, train_steps: int):
+    """Experiment against a registered fake-remote scheme (the staged
+    hdfs://-class path): gather-to-host-0 checkpointing under a real
+    2-process world (VERDICT r3 item 6)."""
+
+    def experiment_fn():
+        import optax
+
+        from tf_yarn_tpu import fs as fs_lib
+        from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+        from tf_yarn_tpu.models import common, mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        from pyarrow import fs as pafs
+
+        local = pafs.LocalFileSystem()
+        fs_lib.register_scheme(
+            "stagefs",
+            lambda uri: (local, remote_base + "/" + uri[len("stagefs://"):]),
+        )
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=lambda: common.synthetic_classification_iter(
+                4, 16, 4),
+            train_params=TrainParams(
+                train_steps=train_steps, log_every_steps=2,
+                checkpoint_every_steps=3,
+            ),
+            mesh_spec=MeshSpec(dp=2),
+            model_dir="stagefs://model",
+        )
+
+    return experiment_fn
+
+
+def test_multihost_staged_remote_checkpointing(tmp_path):
+    """Staged (hdfs://-class) model_dir under 2 real processes: the global
+    state is gathered to host 0, which stages+uploads one complete
+    checkpoint; a fresh 2-process run restores from it and continues."""
+    import os
+
+    remote_base = str(tmp_path / "fake_remote")
+    os.makedirs(remote_base)
+
+    run_on_tpu(
+        _staged_remote_experiment_fn(remote_base, train_steps=6),
+        {"worker": TaskSpec(instances=2)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        poll_every_secs=0.3,
+    )
+    listed = sorted(
+        name for name in os.listdir(os.path.join(remote_base, "model"))
+    )
+    # Only committed ckpt-<step> trees are visible — no staging debris
+    # (the `tb` dir is the remote TB event spool, uploaded alongside).
+    committed = [n for n in listed if n.startswith("ckpt-")]
+    assert committed == ["ckpt-3", "ckpt-6"], listed
+    assert not any(n.startswith(".staging") for n in listed), listed
+
+    # A fresh 2-process world resumes from step 6 and reaches 9.
+    run_on_tpu(
+        _staged_remote_experiment_fn(remote_base, train_steps=9),
+        {"worker": TaskSpec(instances=2)},
+        env={"TPU_YARN_PLATFORM": "cpu"},
+        poll_every_secs=0.3,
+    )
+    committed = sorted(
+        name for name in os.listdir(os.path.join(remote_base, "model"))
+    )
+    assert "ckpt-9" in committed, committed
+
+
 def test_two_process_data_parallel_training(tmp_path):
     out = str(tmp_path / "world")
 

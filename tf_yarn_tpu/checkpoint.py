@@ -19,9 +19,14 @@ paths:
 * any other scheme (``hdfs://``, registered vendor fs) — **staged**: orbax
   writes a local temp dir, the tree is uploaded to
   ``.staging-ckpt-<step>`` (invisible to discovery) and renamed into
-  place, so pollers only ever see committed checkpoints. Staged mode is
-  single-host only: multi-host jobs write shards from every process and
-  need a filesystem orbax can target directly (shared mount or gs://).
+  place, so pollers only ever see committed checkpoints. Under multi-host
+  the global state is first gathered to every host
+  (``multihost_utils.process_allgather``) and host 0 alone stages +
+  uploads one complete checkpoint — the reference's HDFS ``model_dir``
+  with multi-container jobs (reference: pytorch/model_ckpt.py:31-44,
+  tensorflow/tasks/evaluator_task.py:38-51). Gated on the gathered state
+  fitting in host RAM; models too big for one host need a filesystem
+  orbax can target directly (shared mount or gs://).
 """
 
 from __future__ import annotations
@@ -69,17 +74,102 @@ def _is_staged(model_dir: str) -> bool:
     return scheme not in ("", "file") and scheme not in _ORBAX_NATIVE_SCHEMES
 
 
-def _require_single_host(what: str) -> None:
+def _host_available_ram() -> int:
+    """Bytes of host memory a staged snapshot may reasonably claim.
+    0 = unknown (gate disabled)."""
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError, AttributeError):
+        return 0
+
+
+def _state_nbytes(state: Any) -> int:
+    """Global byte size of a pytree of arrays (jax.Array .size is the
+    GLOBAL element count, so this prices the gathered snapshot)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        size = getattr(leaf, "size", None)
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if size and itemsize:
+            total += int(size) * int(itemsize)
+    return total
+
+
+def _snapshot_for_staging(state: Any):
+    """(host-numpy snapshot, am_I_the_uploader).
+
+    Single-host: a device_get copy (preserves the train loop's donation
+    guarantee — the caller may overwrite device buffers immediately).
+    Multi-host: gather the GLOBAL state to every host and elect host 0 to
+    stage + upload one complete checkpoint (the reference's HDFS
+    model_dir deployment, pytorch/model_ckpt.py:31-44). This is a
+    collective: every process must call it. Fail-fast when the gathered
+    state cannot fit in host RAM — better a clear error at save time than
+    an OOM kill mid-upload."""
     import jax
 
     if jax.process_count() > 1:
-        raise ValueError(
-            f"{what} is single-host only: every process writes its own "
-            "array shards, and staging-then-uploading per host would "
-            "scatter one checkpoint across machines. Multi-host jobs need "
-            "a model_dir orbax can write directly — a shared mount or "
-            "gs://."
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        nbytes = _state_nbytes(state)
+        avail = _host_available_ram()
+        fits = 0 if (avail and nbytes > avail // 2) else 1
+        # The fit decision must be AGREED before anyone enters the gather:
+        # hosts see different MemAvailable, and one host raising while the
+        # others enter the collective would wedge the job in an allgather
+        # instead of failing with this message.
+        all_fit = bool(np.min(
+            multihost_utils.process_allgather(np.int32(fits))))
+        if not all_fit:
+            raise ValueError(
+                f"staged remote checkpointing gathers the full state "
+                f"({nbytes / 1e9:.2f} GB) to host RAM, and at least one "
+                f"host (this one has {avail / 1e9:.2f} GB available) "
+                "cannot fit it. Use a model_dir orbax can write directly "
+                "— a shared mount or gs:// — so each host streams only "
+                "its own shards."
+            )
+        # tiled=True: reassemble each global array (shards concatenated in
+        # place) rather than stacking one copy per process.
+        snapshot = multihost_utils.process_allgather(state, tiled=True)
+        return snapshot, jax.process_index() == 0
+    snapshot = jax.tree_util.tree_map(
+        lambda leaf: jax.device_get(leaf)
+        if isinstance(leaf, jax.Array)
+        else leaf,
+        state,
+    )
+    return snapshot, True
+
+
+def _local_checkpointer():
+    """A StandardCheckpointer whose process coordination spans only THIS
+    process: staged saves write a host-local tree from the elected
+    uploader while the rest of the world keeps training — barriers over
+    the full world would hang (the peers never enter save())."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() == 1:
+        return ocp.StandardCheckpointer()
+    me = jax.process_index()
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=me,
+            active_processes={me},
+            barrier_sync_key_prefix=f"staged-h{me}",
         )
+    )
 
 
 def _orbax_target(model_dir: str, step: int) -> str:
@@ -97,25 +187,47 @@ def _commit_staged(local_ckpt: str, model_dir: str, step: int) -> None:
     evaluator can't observe a half-uploaded checkpoint."""
     staging = fs_lib.join(model_dir, f".staging-ckpt-{step}")
     final = checkpoint_path(model_dir, step)
+    backup = fs_lib.join(model_dir, f".replaced-ckpt-{step}")
     fs_lib.rmtree(staging)
+    if fs_lib.exists(backup):
+        if fs_lib.exists(final):
+            # Crash happened AFTER the replacement committed: the backup
+            # is debris.
+            fs_lib.rmtree(backup)
+        else:
+            # Crash happened BETWEEN move-aside and commit: the backup is
+            # the only surviving copy of this step — restore it before
+            # attempting the new upload (which may itself fail).
+            fs_lib.move(backup, final)
     fs_lib.mkdirs(model_dir)
     fs_lib.upload_dir(local_ckpt, staging)
-    # Delete a same-step predecessor only once its replacement is fully
-    # uploaded (force semantics, matching orbax save(force=True)) — an
-    # upload failure must never cost the last good checkpoint.
-    fs_lib.rmtree(final)
+    # Replace a same-step predecessor (force semantics, matching orbax
+    # save(force=True)) without a window where neither copy survives: the
+    # old tree is moved aside first — a crash mid-commit leaves it under
+    # the backup name (plus the fully-uploaded staging tree), never
+    # deleted-with-nothing-committed.
+    if fs_lib.exists(final):
+        fs_lib.move(final, backup)
     fs_lib.move(staging, final)
+    fs_lib.rmtree(backup)
+
+
+def _write_staged(model_dir: str, step: int, snapshot: Any) -> None:
+    """Serialize a host-numpy snapshot locally and commit it remotely.
+    Runs only on the elected uploader (and, for the async writer, on its
+    worker thread)."""
+    with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
+        local = os.path.join(tmp, f"ckpt-{step}")
+        with _local_checkpointer() as ckptr:
+            ckptr.save(local, snapshot, force=True)
+        _commit_staged(local, model_dir, step)
 
 
 def _staged_save(model_dir: str, step: int, state: Any) -> None:
-    import orbax.checkpoint as ocp
-
-    _require_single_host("staged remote checkpointing")
-    with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
-        local = os.path.join(tmp, f"ckpt-{step}")
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(local, state, force=True)
-        _commit_staged(local, model_dir, step)
+    """Synchronous staged save (collective under multi-host)."""
+    snapshot, uploader = _snapshot_for_staging(state)
+    if uploader:
+        _write_staged(model_dir, step, snapshot)
 
 
 @contextlib.contextmanager
@@ -197,38 +309,41 @@ class CheckpointWriter:
 
     def _staged_async_save(self, model_dir: str, step: int, state: Any) -> None:
         """Snapshot to host now (preserving the donation guarantee), then
-        serialize + upload + rename on the worker thread."""
+        serialize + upload + rename on the worker thread. Collective
+        under multi-host: every process gathers, host 0 uploads."""
         import concurrent.futures
 
-        import jax
-
-        _require_single_host("staged remote checkpointing")
         # Backpressure: at most one upload in flight. Each snapshot pins a
         # full host-RAM copy of the state; letting them queue behind a
         # slow link would grow memory without bound.
         self._raise_staged_errors(block=True)
-        snapshot = jax.tree_util.tree_map(
-            lambda leaf: jax.device_get(leaf)
-            if isinstance(leaf, jax.Array)
-            else leaf,
-            state,
-        )
+        snapshot, uploader = _snapshot_for_staging(state)
+        if not uploader:
+            return
         if self._executor is None:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-stage"
             )
         self._staged_futures.append(
-            self._executor.submit(_staged_save, model_dir, step, snapshot)
+            self._executor.submit(_write_staged, model_dir, step, snapshot)
         )
 
     def _raise_staged_errors(self, block: bool) -> None:
-        pending = []
+        """Surface failures of background staged saves to the caller (an
+        upload failure from save(N) raises from the next save()/wait()).
+        Settled futures leave the queue even when raising, so one failure
+        is reported once — not re-raised by every later call."""
+        pending, errors = [], []
         for future in self._staged_futures:
             if block or future.done():
-                future.result()  # re-raises upload failures
+                exc = future.exception()  # waits when block=True
+                if exc is not None:
+                    errors.append(exc)
             else:
                 pending.append(future)
         self._staged_futures = pending
+        if errors:
+            raise errors[0]
 
     def _gc(self, model_dir: str) -> None:
         if not self.keep_last_n:

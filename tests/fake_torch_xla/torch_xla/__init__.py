@@ -1,0 +1,22 @@
+"""TEST-ONLY torch_xla shim — wiring verification, NOT real torch-xla.
+
+This image has no torch_xla wheel and no egress (docs/TorchXLA.md), so the
+`xla://` branch of tasks/pytorch_worker.py could never execute. This shim
+makes the *wiring* executable in CI — backend auto-detection, `xla://`
+rendezvous, device selection, DDP wrap, optimizer steps — by presenting
+torch_xla's import surface over stock torch primitives:
+
+* ``distributed.xla_backend`` registers an ``xla`` process-group backend
+  (gloo underneath) and an ``xla://`` rendezvous handler reading the
+  RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT env the real one reads
+  (reference: tf_yarn/pytorch/tasks/worker.py:101-107 takes the same
+  path against real torch_xla).
+* ``core.xla_model.xla_device()`` returns the CPU device.
+
+What this does NOT verify: ICI collectives, XLA tensor semantics, TPU
+placement. A run on a real TPU VM with the real wheel is still the only
+proof of those; see docs/TorchXLA.md for the split.
+"""
+
+IS_FAKE_SHIM = True
+__version__ = "0.0-fake-wiring-shim"

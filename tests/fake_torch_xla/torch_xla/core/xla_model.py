@@ -1,0 +1,24 @@
+"""Fake xla_model: the device-selection surface pytorch.get_device uses.
+
+Real torch_xla returns an XLA device handle backed by the TPU runtime;
+the shim returns CPU so the wiring downstream (``model.to(device)``,
+tensors on the loader path) executes with identical code.
+"""
+
+import torch
+
+
+def xla_device():
+    return torch.device("cpu")
+
+
+def xrt_world_size() -> int:
+    import os
+
+    return int(os.environ.get("WORLD_SIZE", "1"))
+
+
+def get_ordinal() -> int:
+    import os
+
+    return int(os.environ.get("RANK", "0"))

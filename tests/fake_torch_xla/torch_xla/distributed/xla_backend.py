@@ -1,0 +1,47 @@
+"""Fake xla_backend: importing this registers the ``xla`` process-group
+backend and the ``xla://`` rendezvous, exactly the side effect the real
+``torch_xla.distributed.xla_backend`` import has (and the reason
+tasks/pytorch_worker.py imports it before ``init_process_group``).
+
+The process group is gloo underneath — collective *wiring* (DDP bucket
+allreduce, barriers) executes for real across processes; what's fake is
+only that bytes move over sockets instead of ICI.
+"""
+
+import datetime
+import os
+
+import torch.distributed as dist
+from torch.distributed import TCPStore
+from torch.distributed.rendezvous import register_rendezvous_handler
+
+
+def _xla_rendezvous_handler(url, timeout=datetime.timedelta(seconds=300),
+                            **kwargs):
+    """``xla://`` rendezvous: identity and master address come from the
+    env trio the launcher exports (RANK/WORLD_SIZE/MASTER_ADDR/PORT) —
+    the same contract real torch_xla's xla:// init method reads."""
+    rank = int(os.environ["RANK"])
+    world_size = int(os.environ["WORLD_SIZE"])
+    store = TCPStore(
+        os.environ["MASTER_ADDR"],
+        int(os.environ["MASTER_PORT"]),
+        world_size,
+        rank == 0,
+        timeout=timeout,
+    )
+    yield (store, rank, world_size)
+
+
+def _create_fake_xla_process_group(store, rank, size,
+                                   timeout=datetime.timedelta(seconds=300)):
+    from torch.distributed import ProcessGroupGloo
+
+    return ProcessGroupGloo(store, rank, size, timeout)
+
+
+if "xla" not in dist.Backend.backend_list:
+    dist.Backend.register_backend(
+        "xla", _create_fake_xla_process_group, devices=["cpu"]
+    )
+    register_rendezvous_handler("xla", _xla_rendezvous_handler)

@@ -81,6 +81,62 @@ def test_pytorch_ddp_e2e_two_workers(tmp_path):
     assert state["epoch"] == 3
 
 
+def test_pytorch_xla_branch_wiring_via_fake_shim(tmp_path):
+    """VERDICT r3 item 5: the xla:// branch of tasks/pytorch_worker.py
+    executes end-to-end against the vendored tests/fake_torch_xla shim —
+    backend autodetection (collective_backend -> "xla"), the xla://
+    rendezvous, xla_device() selection, DDP wrap, and real optimizer
+    steps across 2 worker processes. Wiring-only verification: ICI and
+    XLA tensor semantics remain unverified (docs/TorchXLA.md)."""
+    shim = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fake_torch_xla")
+    out = str(tmp_path / "backend")
+
+    def experiment_fn():
+        import torch as t
+
+        from tf_yarn_tpu import pytorch as ptm
+
+        x = t.randn(32, 4)
+        y = (x.sum(dim=1, keepdim=True) > 0).float()
+        dataset = t.utils.data.TensorDataset(x, y)
+
+        def main_fn(model, loader, device, rank, tb_writer):
+            import torch.distributed as dist
+            import torch_xla
+
+            assert getattr(torch_xla, "IS_FAKE_SHIM", False)
+            opt = t.optim.SGD(model.parameters(), lr=0.05)
+            loss_fn = t.nn.BCEWithLogitsLoss()
+            for xb, yb in loader:
+                opt.zero_grad()
+                loss = loss_fn(model(xb.to(device)), yb.to(device))
+                loss.backward()
+                opt.step()
+            with open(f"{out}-{rank}", "w") as fh:
+                fh.write(f"{dist.get_backend()} {device.type} "
+                         f"wrap={type(model).__name__}")
+
+        return ptm.PytorchExperiment(
+            model=t.nn.Linear(4, 1),
+            main_fn=main_fn,
+            train_dataset=dataset,
+            dataloader_args=ptm.DataLoaderArgs(batch_size=8),
+        )
+
+    pt.run_on_tpu(
+        experiment_fn,
+        {"worker": TaskSpec(instances=2)},
+        env={"PYTHONPATH": shim},
+        poll_every_secs=0.3,
+    )
+    for rank in (0, 1):
+        with open(f"{out}-{rank}") as fh:
+            content = fh.read()
+        assert content.startswith("xla cpu"), content
+        assert "DistributedDataParallel" in content, content
+
+
 def test_xla_backend_without_torch_xla_raises_clearly():
     """The xla branch is gated, not silently broken, on rigs without
     torch_xla (VERDICT r1 item 5)."""

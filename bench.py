@@ -32,9 +32,109 @@ import time
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _AB_PATH = os.path.join(_REPO, "BENCH_AB.json")
 
+# The flagship TPU bench config. Module-level so the stale-provenance
+# path can tell whether a carried-forward number measured THIS model
+# (round-3 verdict weak #6: best-row selection must not silently compare
+# different configs across rounds).
+_TPU_BASE = dict(
+    vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+    n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+)
+_TPU_BATCH, _TPU_SEQ, _TPU_STEPS = 8, 1024, 20
+
+
+def _config_hash(cfg: dict) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(cfg, sort_keys=True, default=str).encode()
+    ).hexdigest()[:12]
+
 
 def _log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
+
+
+def _accel_env() -> dict:
+    """TPU_*/JAX_*/XLA_* env for the wedge postmortem."""
+    return {
+        k: v for k, v in os.environ.items()
+        if k.startswith(("TPU_", "JAX_", "XLA_", "LIBTPU", "PJRT_"))
+    }
+
+
+def _accel_holders() -> tuple:
+    """(holders, uninspectable): other processes holding accelerator
+    device files or the libtpu lockfile — the usual cause of a device-init
+    hang that no amount of waiting fixes (an orphan from a SIGKILLed run
+    keeps the chip). `uninspectable` counts live pids whose fd tables we
+    could not read (another user's process): with any of those, "no
+    holder found" proves nothing and remediation must not assume the
+    lockfile is stale."""
+    holders = []
+    uninspectable = 0
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return holders, 1
+    me = os.getpid()
+    for pid in pids:
+        if int(pid) == me:
+            continue
+        fd_dir = f"/proc/{pid}/fd"
+        try:
+            fds = os.listdir(fd_dir)
+        except OSError:
+            if os.path.isdir(f"/proc/{pid}"):
+                uninspectable += 1  # permission-denied, not a raced exit
+            continue
+        for fd in fds:
+            try:
+                target = os.readlink(os.path.join(fd_dir, fd))
+            except OSError:
+                continue
+            if ("/dev/accel" in target or "libtpu_lockfile" in target
+                    or "/dev/vfio" in target):
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                        cmd = fh.read().replace(b"\0", b" ").decode(
+                            errors="replace").strip()[:160]
+                except OSError:
+                    cmd = "?"
+                holders.append({"pid": int(pid), "file": target, "cmd": cmd})
+                break
+    return holders, uninspectable
+
+
+def _attempt_unwedge(attempt: int) -> None:
+    """Between probes, try the recoverable causes of a hung device init
+    instead of only waiting out the budget (round-3 verdict item 3):
+    report orphan processes holding the chip, remove a stale
+    /tmp/libtpu_lockfile nobody holds, and log the accelerator env once
+    for the postmortem."""
+    if attempt == 1:
+        _log(f"accelerator env: {json.dumps(_accel_env(), sort_keys=True)}")
+    holders, uninspectable = _accel_holders()
+    if holders:
+        # Killing someone else's process is not the bench's call — but
+        # naming it turns "relay wedged all round" into an actionable
+        # report.
+        _log(f"accelerator held by other processes: {json.dumps(holders)}")
+        return
+    if uninspectable:
+        # A pid we couldn't inspect may be the holder: removing the
+        # lockfile under a live holder would make two processes contend
+        # for the chip. Report and leave it.
+        _log(f"{uninspectable} live processes uninspectable; not touching "
+             "the lockfile")
+        return
+    lock = "/tmp/libtpu_lockfile"
+    if os.path.exists(lock):
+        try:
+            os.unlink(lock)
+            _log(f"removed stale {lock} (no live holder)")
+        except OSError as exc:
+            _log(f"could not remove {lock}: {exc}")
 
 
 def _probe_backend_alive() -> bool:
@@ -76,6 +176,7 @@ def _probe_backend_alive() -> bool:
         except subprocess.TimeoutExpired:
             hard_failures = 0
             _log(f"probe attempt {attempt}: device init hung {per_try:.0f}s")
+        _attempt_unwedge(attempt)
         remaining = deadline - time.time()
         if remaining <= 1:
             return False
@@ -128,6 +229,11 @@ def _stale_tpu_fields() -> dict:
     }
     if not provenance["git_commit"]:
         provenance = _ab_file_provenance()
+    stale_hash = table.get("config_hash") or (
+        _config_hash(table["config"]) if table.get("config") else None
+    )
+    current_hash = _config_hash(
+        {**_TPU_BASE, "batch": _TPU_BATCH, "seq": _TPU_SEQ})
     fields = {
         "last_tpu_value": best["samples_per_sec_per_chip"],
         "last_tpu_mfu": best.get("mfu"),
@@ -135,6 +241,12 @@ def _stale_tpu_fields() -> dict:
         "last_tpu_device": table.get("device"),
         "last_tpu_commit": provenance["git_commit"],
         "last_tpu_date": provenance["measured_at"],
+        # Pin WHAT was measured: a future dim change must be visible,
+        # not silently compared across rounds.
+        "last_tpu_config_hash": stale_hash,
+        "last_tpu_config_matches_current": (
+            stale_hash == current_hash if stale_hash else None
+        ),
     }
     decode = table.get("decode") or {}
     for key in ("decode_tokens_per_sec_bf16", "decode_tokens_per_sec_int8"):
@@ -207,11 +319,8 @@ def bench_flagship_train():
     if on_tpu:
         # remat off: this config's activations fit one chip's HBM, so
         # recompute would only burn MXU cycles.
-        base = dict(
-            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
-            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
-        )
-        batch_size, seq_len, steps = 8, 1024, 20
+        base = dict(_TPU_BASE)
+        batch_size, seq_len, steps = _TPU_BATCH, _TPU_SEQ, _TPU_STEPS
         # Axes: layer-scan on/off (unrolling lets XLA fuse across layer
         # boundaries — measured ~+25% on v5e), attention xla/flash, fused
         # pallas norms on/off.
@@ -299,6 +408,8 @@ def bench_flagship_train():
         previous = {}
     ab = {
         "config": {**base, "batch": batch_size, "seq": seq_len},
+        "config_hash": _config_hash({**base, "batch": batch_size,
+                                     "seq": seq_len}),
         "device": devices[0].device_kind,
         "git_commit": _git_head(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),

@@ -218,6 +218,43 @@ def test_resnet_forward_and_train():
     assert np.isfinite(metrics["loss"])
 
 
+def test_resnet_space_to_depth_stem():
+    """The MXU-friendly stem (docs/ResNetMFU.md): same logits shape and
+    same post-stem spatial grid as the classic conv+pool stem, and it
+    trains."""
+    import pytest
+
+    cfg = resnet.ResNetConfig.tiny(stem="space_to_depth")
+    model = resnet.ResNet(cfg)
+    images = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), images)
+    assert model.apply(variables, images).shape == (2, cfg.num_classes)
+    # The stem conv reads the 16*3=48 repacked channels with a 2x2
+    # window (vs 7x7 over 3 channels): that's the whole point — the MXU
+    # input lanes fill.
+    assert variables["params"]["stem"]["kernel"].shape == (2, 2, 48, cfg.width)
+    # Post-stem grid parity with conv+pool: 32px -> 8x8 into stage 0 for
+    # BOTH stems (the MFU A/B must compare equal-work stages).
+    for stem in ("conv", "space_to_depth"):
+        m = resnet.ResNet(resnet.ResNetConfig.tiny(stem=stem))
+        v = m.init(jax.random.PRNGKey(0), images)
+        _, inter = m.apply(v, images, capture_intermediates=True)
+        stage0_in = inter["intermediates"]["stage0_block0"]["__call__"][0]
+        assert stage0_in.shape[1:3] == (8, 8), (stem, stage0_in.shape)
+    # Guard rails: typo'd stems and non-divisible inputs fail loudly.
+    with pytest.raises(ValueError, match="stem"):
+        resnet.ResNetConfig.tiny(stem="s2d")
+    with pytest.raises(ValueError, match="divisible by 4"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 30, 30, 3)))
+
+    exp = resnet.make_experiment(
+        cfg, train_steps=4, batch_size=8, image_size=32,
+        learning_rate=0.01, mesh_spec=MeshSpec(dp=8),
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
+    assert np.isfinite(metrics["loss"])
+
+
 def test_linear_classifier_learns():
     cfg = linear.LinearConfig(n_buckets=1024, n_features=8)
     exp = linear.make_experiment(

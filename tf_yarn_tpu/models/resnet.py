@@ -26,6 +26,22 @@ class ResNetConfig:
     num_groups: int = 32
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # "conv": the classic 7x7-stride-2 conv + 3x3 maxpool stem.
+    # "space_to_depth": 4x4 space-to-depth then a 2x2 conv — the MLPerf-
+    # style TPU stem. The classic stem feeds the MXU a 3-input-channel
+    # conv (<=3/128 lane fill): ~6% of the model's FLOPs at a few percent
+    # efficiency, enough to cap whole-model MFU (docs/ResNetMFU.md).
+    # s2d repacks 4x4 pixel blocks into 48 channels so the first conv
+    # fills the systolic array; same 56x56 output grid and stride as
+    # conv7x7s2 + pool3x3s2 (receptive field 8x8 vs the classic 11x11 —
+    # an architecture variant, not a reparametrization). Requires H, W
+    # divisible by 4.
+    stem: str = "conv"
+
+    def __post_init__(self):
+        if self.stem not in ("conv", "space_to_depth"):
+            raise ValueError(
+                f"stem must be 'conv' or 'space_to_depth', got {self.stem!r}")
 
     @classmethod
     def resnet50(cls, **overrides) -> "ResNetConfig":
@@ -76,12 +92,31 @@ class ResNet(nn.Module):
         # deterministic accepted for loss-contract uniformity (no dropout).
         cfg = self.config
         x = x.astype(cfg.dtype)
-        x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="stem")(x)
-        x = nn.relu(nn.GroupNorm(num_groups=min(cfg.num_groups, cfg.width),
-                                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                                 name="stem_norm")(x))
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        if cfg.stem == "space_to_depth":
+            # [B, H, W, 3] -> [B, H/4, W/4, 48]: 4x4 pixel blocks become
+            # channels, so the stem conv reads 48 input channels instead
+            # of 3 and the MXU's input lanes actually fill. einops-style
+            # rearrange via reshape/transpose; XLA lowers this to a copy.
+            b, h, w, c = x.shape
+            if h % 4 or w % 4:
+                raise ValueError(
+                    f"space_to_depth stem needs H, W divisible by 4, got "
+                    f"{h}x{w}; pad/crop the input or use stem='conv'")
+            x = x.reshape(b, h // 4, 4, w // 4, 4, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4, 16 * c)
+            x = nn.Conv(cfg.width, (2, 2), use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="stem")(x)
+            x = nn.relu(nn.GroupNorm(
+                num_groups=min(cfg.num_groups, cfg.width), dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="stem_norm")(x))
+        else:
+            x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        name="stem")(x)
+            x = nn.relu(nn.GroupNorm(num_groups=min(cfg.num_groups, cfg.width),
+                                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                     name="stem_norm")(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(cfg.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1

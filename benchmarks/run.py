@@ -125,15 +125,19 @@ def bench_resnet50(tpu: bool):
     size = 224 if tpu else 32
     rng = np.random.RandomState(0)
     variants = (
-        [("conv_b64", "conv", 64), ("s2d_b64", "space_to_depth", 64),
-         ("s2d_b128", "space_to_depth", 128)]
-        if tpu else [("conv", "conv", 8)]
+        [("conv_b64", "conv", 64, False),
+         ("s2d_b64", "space_to_depth", 64, False),
+         ("s2d_b128", "space_to_depth", 128, False),
+         ("s2d_fused_gn_b128", "space_to_depth", 128, True)]
+        if tpu else [("conv", "conv", 8, False)]
     )
     rows = {}
     best = None
-    for name, stem, batch in variants:
-        config = (resnet.ResNetConfig.resnet50(stem=stem) if tpu
-                  else resnet.ResNetConfig.tiny(stem=stem))
+    for name, stem, batch, fused in variants:
+        config = (
+            resnet.ResNetConfig.resnet50(stem=stem, fused_norms=fused)
+            if tpu
+            else resnet.ResNetConfig.tiny(stem=stem, fused_norms=fused))
         model = resnet.ResNet(config)
         try:
             stats = measure_throughput(

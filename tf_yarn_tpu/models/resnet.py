@@ -38,6 +38,12 @@ class ResNetConfig:
     # divisible by 4.
     stem: str = "conv"
 
+    # Fused pallas GroupNorm (ops/groupnorm.py): one HBM round-trip per
+    # norm instead of XLA's separate stats + normalize passes — targets
+    # docs/ResNetMFU.md hypothesis 2. Param names match nn.GroupNorm, so
+    # checkpoints swap freely between fused and unfused.
+    fused_norms: bool = False
+
     def __post_init__(self):
         if self.stem not in ("conv", "space_to_depth"):
             raise ValueError(
@@ -54,6 +60,27 @@ class ResNetConfig:
         return cls(**defaults)
 
 
+class GroupNormOp(nn.Module):
+    """GroupNorm with the same param names/shapes as nn.GroupNorm,
+    routable through the fused pallas kernel (config.fused_norms)."""
+
+    num_groups: int
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        from tf_yarn_tpu.ops import groupnorm as gn_ops
+
+        c = x.shape[-1]
+        scale = self.param(
+            "scale", nn.initializers.ones, (c,), cfg.param_dtype)
+        bias = self.param(
+            "bias", nn.initializers.zeros, (c,), cfg.param_dtype)
+        fn = gn_ops.groupnorm if cfg.fused_norms else gn_ops.groupnorm_reference
+        return fn(x, scale, bias, self.num_groups, eps=1e-6).astype(cfg.dtype)
+
+
 class Bottleneck(nn.Module):
     filters: int
     strides: int
@@ -64,8 +91,8 @@ class Bottleneck(nn.Module):
         cfg = self.config
         conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype)
-        norm = partial(nn.GroupNorm, num_groups=min(cfg.num_groups, self.filters),
-                       dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        norm = partial(GroupNormOp, num_groups=min(cfg.num_groups, self.filters),
+                       config=cfg)
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="norm1")(y))
@@ -106,16 +133,15 @@ class ResNet(nn.Module):
             x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 4, w // 4, 16 * c)
             x = nn.Conv(cfg.width, (2, 2), use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="stem")(x)
-            x = nn.relu(nn.GroupNorm(
-                num_groups=min(cfg.num_groups, cfg.width), dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype, name="stem_norm")(x))
+            x = nn.relu(GroupNormOp(
+                num_groups=min(cfg.num_groups, cfg.width), config=cfg,
+                name="stem_norm")(x))
         else:
             x = nn.Conv(cfg.width, (7, 7), strides=(2, 2), use_bias=False,
                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                         name="stem")(x)
-            x = nn.relu(nn.GroupNorm(num_groups=min(cfg.num_groups, cfg.width),
-                                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                                     name="stem_norm")(x))
+            x = nn.relu(GroupNormOp(num_groups=min(cfg.num_groups, cfg.width),
+                                    config=cfg, name="stem_norm")(x))
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(cfg.stage_sizes):
             for block in range(n_blocks):

@@ -1,0 +1,145 @@
+"""Fused GroupNorm — pallas TPU kernel (NHWC).
+
+GroupNorm is the resnet family's norm (models/resnet.py: no cross-step
+running stats, pure train step). XLA lowers it as separate reduce /
+rsqrt / broadcast-multiply HLOs, re-reading the activation from HBM for
+the stats pass and again for the normalize pass — at resnet50's early
+stages that traffic is a material slice of step time
+(docs/ResNetMFU.md hypothesis 2). This kernel reads each [H*W, C] slab
+once into VMEM, computes per-group stats and the normalized output on
+the VPU/MXU, and writes once.
+
+Lane-friendly group reduction: instead of reshaping [HW, C] ->
+[HW, G, C/G] (which would demote the lane dim to C/G, as small as 2),
+per-channel sums are folded into per-group sums with a [C, G] one-hot
+assignment matmul, and group stats broadcast back with its transpose —
+the MXU does the bookkeeping and the lane dim stays C.
+
+Backward recomputes through the XLA reference (same rematerialization
+trade as ops/rmsnorm.py and ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def groupnorm_reference(x, scale, bias, groups: int, eps: float = 1e-5):
+    """[..., H, W, C] (or any [..., C]) GroupNorm matching flax
+    nn.GroupNorm semantics: stats over all non-batch dims within each
+    channel group."""
+    b, c = x.shape[0], x.shape[-1]
+    if c % groups:
+        raise ValueError(
+            f"channels ({c}) must divide into groups ({groups})")
+    x32 = x.astype(jnp.float32)
+    xg = x32.reshape(b, -1, groups, c // groups)
+    mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
+    var = jnp.mean((xg - mean) ** 2, axis=(1, 3), keepdims=True)
+    norm = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    return (norm * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _groupnorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *,
+                      groups: int, eps: float):
+    x = x_ref[...].astype(jnp.float32)  # [1, HW, C] block: one batch elem
+    hw, c = x.shape[-2], x.shape[-1]
+    cg = c // groups
+    x2d = x.reshape(hw, c)
+    # One-hot channel->group assignment, built from iota (no gathers).
+    chan = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
+    grp = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
+    assign = (chan // cg == grp).astype(jnp.float32)  # [C, G]
+    # Per-channel sums -> per-group stats via the assignment matmul.
+    sum_c = jnp.sum(x2d, axis=0)          # [C]
+    sumsq_c = jnp.sum(x2d * x2d, axis=0)  # [C]
+    n = jnp.float32(hw * cg)
+    mean_g = (sum_c @ assign) / n                     # [G]
+    # One-pass variance can round negative under f32 cancellation (large
+    # mean, tiny spread: ulp at 1e6 is ~0.06); clamp like flax's
+    # use_fast_variance path or rsqrt(negative) poisons the slab with NaN.
+    var_g = jnp.maximum(
+        (sumsq_c @ assign) / n - mean_g * mean_g, 0.0)  # [G]
+    inv_g = jax.lax.rsqrt(var_g + eps)
+    # Broadcast group stats back onto channels: [G] @ [G, C].
+    mean_c = mean_g @ assign.T
+    inv_c = inv_g @ assign.T
+    y = (x2d - mean_c[None, :]) * inv_c[None, :]
+    y = y * scale_ref[...].astype(jnp.float32)[None, :]
+    y = y + bias_ref[...].astype(jnp.float32)[None, :]
+    o_ref[...] = y.reshape(x.shape).astype(o_ref.dtype)
+
+
+def _groupnorm_forward(x, scale, bias, groups, eps, interpret):
+    b, c = x.shape[0], x.shape[-1]
+    hw = 1
+    for dim in x.shape[1:-1]:
+        hw *= dim
+    x3 = x.reshape(b, hw, c)
+    out = pl.pallas_call(
+        functools.partial(_groupnorm_kernel, groups=groups, eps=eps),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+        interpret=interpret,
+    )(x3, scale, bias)
+    return out.reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _groupnorm(x, scale, bias, groups, eps, interpret):
+    return _groupnorm_forward(x, scale, bias, groups, eps, interpret)
+
+
+def _groupnorm_fwd(x, scale, bias, groups, eps, interpret):
+    return (_groupnorm_forward(x, scale, bias, groups, eps, interpret),
+            (x, scale, bias))
+
+
+def _groupnorm_bwd(groups, eps, interpret, residuals, g):
+    x, scale, bias = residuals
+    _, vjp = jax.vjp(
+        lambda x, s, b: groupnorm_reference(x, s, b, groups, eps),
+        x, scale, bias,
+    )
+    return vjp(g)
+
+
+_groupnorm.defvjp(_groupnorm_fwd, _groupnorm_bwd)
+
+# One batch element's [HW, C] slab must fit VMEM alongside the f32
+# compute copies; past this, fall back to XLA (resnet50 slabs are <=4MB).
+_MAX_SLAB_BYTES = 8 * 1024 * 1024
+
+
+def groupnorm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    groups: int,
+    eps: float = 1e-5,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused GroupNorm over the channel (last) dim; differentiable.
+    Falls back to the XLA reference when a batch element's slab would
+    not fit VMEM or channels don't divide into groups."""
+    c = x.shape[-1]
+    hw = 1
+    for dim in x.shape[1:-1]:
+        hw *= dim
+    if c % groups or hw * c * 4 > _MAX_SLAB_BYTES:
+        return groupnorm_reference(x, scale, bias, groups, eps)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _groupnorm(x, scale, bias, groups, eps, interpret)

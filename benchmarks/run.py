@@ -29,6 +29,30 @@ def _on_tpu() -> bool:
     return select_devices()[0].platform == "tpu"
 
 
+def _best_of_variants(variants, run_one):
+    """Shared A/B sweep shape: run each (name, spec), keep per-variant
+    samples/sec + MFU rows, return the best run's full stats with the
+    rows attached. One bad variant never kills the sweep."""
+    rows, best = {}, None
+    for name, spec in variants:
+        try:
+            stats = run_one(spec)
+        except Exception as exc:
+            rows[name] = {"error": str(exc)[:160]}
+            continue
+        rows[name] = {
+            "samples_per_sec_per_chip": stats["samples_per_sec_per_chip"],
+            "mfu": stats.get("mfu"),
+        }
+        if best is None or (stats["samples_per_sec_per_chip"]
+                            > best["samples_per_sec_per_chip"]):
+            best = dict(stats, variant=name)
+    if best is None:
+        return {"variants": rows}
+    best["variants"] = rows
+    return best
+
+
 def bench_mnist_dense(tpu: bool):
     import numpy as np
     import optax
@@ -80,12 +104,11 @@ def bench_bert_base(tpu: bool):
     from tf_yarn_tpu.benchmark import measure_throughput
     from tf_yarn_tpu.models import bert
 
-    config = bert.BertConfig.base() if tpu else bert.BertConfig.tiny()
     # b64 from the round-2 sweep: b16 left the MXU underfed (MFU 0.27 ->
-    # 0.46); s128 is the classic fine-tune shape.
+    # 0.46); s128 is the classic fine-tune shape. On TPU the fused pallas
+    # LayerNorm (ops/layernorm.py) rides as an A/B variant.
     batch, seq = (64, 128) if tpu else (8, 32)
     rng = np.random.RandomState(0)
-    model = bert.BertClassifier(config)
 
     def loss_fn(model, params, batch, rng_, train=True):
         import jax.numpy as jnp
@@ -98,17 +121,27 @@ def bench_bert_base(tpu: bool):
         ).mean()
         return loss, {"accuracy": jnp.mean(jnp.argmax(logits, -1) == batch["y"])}
 
-    return measure_throughput(
-        model,
-        loss_fn,
-        optax.adamw(2e-5),
-        {
-            "x": rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32),
-            "y": rng.randint(0, config.num_classes, batch).astype(np.int32),
-        },
-        init_fn=lambda r, b: model.init(r, b["x"]),
-        steps=10 if tpu else 5,
-    )
+    def run_one(fused):
+        config = (bert.BertConfig.base(fused_norms=fused) if tpu
+                  else bert.BertConfig.tiny(fused_norms=fused))
+        model = bert.BertClassifier(config)
+        return measure_throughput(
+            model,
+            loss_fn,
+            optax.adamw(2e-5),
+            {
+                "x": rng.randint(
+                    0, config.vocab_size, (batch, seq)).astype(np.int32),
+                "y": rng.randint(
+                    0, config.num_classes, batch).astype(np.int32),
+            },
+            init_fn=lambda r, b: model.init(r, b["x"]),
+            steps=10 if tpu else 5,
+        )
+
+    variants = ([("base", False), ("fused_ln", True)] if tpu
+                else [("base", False)])
+    return _best_of_variants(variants, run_one)
 
 
 def bench_resnet50(tpu: bool):
@@ -124,47 +157,34 @@ def bench_resnet50(tpu: bool):
 
     size = 224 if tpu else 32
     rng = np.random.RandomState(0)
-    variants = (
-        [("conv_b64", "conv", 64, False),
-         ("s2d_b64", "space_to_depth", 64, False),
-         ("s2d_b128", "space_to_depth", 128, False),
-         ("s2d_fused_gn_b128", "space_to_depth", 128, True)]
-        if tpu else [("conv", "conv", 8, False)]
-    )
-    rows = {}
-    best = None
-    for name, stem, batch, fused in variants:
+
+    def run_one(spec):
+        stem, batch, fused = spec
         config = (
             resnet.ResNetConfig.resnet50(stem=stem, fused_norms=fused)
             if tpu
             else resnet.ResNetConfig.tiny(stem=stem, fused_norms=fused))
         model = resnet.ResNet(config)
-        try:
-            stats = measure_throughput(
-                model,
-                common.classification_loss,
-                optax.sgd(0.1, momentum=0.9),
-                {
-                    "x": rng.randn(batch, size, size, 3).astype(np.float32),
-                    "y": rng.randint(
-                        0, config.num_classes, batch).astype(np.int32),
-                },
-                steps=10 if tpu else 5,
-            )
-        except Exception as exc:  # one bad variant must not kill the sweep
-            rows[name] = {"error": str(exc)[:160]}
-            continue
-        rows[name] = {
-            "samples_per_sec_per_chip": stats["samples_per_sec_per_chip"],
-            "mfu": stats.get("mfu"),
-        }
-        if best is None or (stats["samples_per_sec_per_chip"]
-                            > best["samples_per_sec_per_chip"]):
-            best = dict(stats, variant=name)
-    if best is None:
-        return {"variants": rows}
-    best["variants"] = rows
-    return best
+        return measure_throughput(
+            model,
+            common.classification_loss,
+            optax.sgd(0.1, momentum=0.9),
+            {
+                "x": rng.randn(batch, size, size, 3).astype(np.float32),
+                "y": rng.randint(
+                    0, config.num_classes, batch).astype(np.int32),
+            },
+            steps=10 if tpu else 5,
+        )
+
+    variants = (
+        [("conv_b64", ("conv", 64, False)),
+         ("s2d_b64", ("space_to_depth", 64, False)),
+         ("s2d_b128", ("space_to_depth", 128, False)),
+         ("s2d_fused_gn_b128", ("space_to_depth", 128, True))]
+        if tpu else [("conv", ("conv", 8, False))]
+    )
+    return _best_of_variants(variants, run_one)
 
 
 def bench_vit_base(tpu: bool):

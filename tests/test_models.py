@@ -202,6 +202,31 @@ def test_bert_forward_and_train():
     assert np.isfinite(metrics["loss"])
 
 
+def test_bert_fused_layernorm_matches_unfused():
+    """fused_norms routes every LayerNorm through the pallas kernel with
+    the SAME param tree (checkpoints swap freely) and matching logits."""
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 16)), jnp.int32)
+    cfg_ref = bert.BertConfig.tiny()
+    cfg_fused = bert.BertConfig.tiny(fused_norms=True)
+    model_ref = bert.BertClassifier(cfg_ref)
+    model_fused = bert.BertClassifier(cfg_fused)
+    variables = model_ref.init(jax.random.PRNGKey(0), tokens)
+    out_ref = model_ref.apply(variables, tokens)
+    out_fused = model_fused.apply(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_ref, np.float32), np.asarray(out_fused, np.float32),
+        atol=5e-2,
+    )
+
+    exp = bert.make_experiment(
+        cfg_fused, train_steps=4, batch_size=16, seq_len=16,
+        mesh_spec=MeshSpec(dp=4, tp=2),
+    )
+    metrics = train_and_evaluate(as_core_experiment(exp), devices=_devices())
+    assert np.isfinite(metrics["loss"])
+
+
 def test_resnet_forward_and_train():
     cfg = resnet.ResNetConfig.tiny()
     model = resnet.ResNet(cfg)

@@ -112,6 +112,49 @@ def test_groupnorm_no_nan_on_near_constant_input():
     assert np.isfinite(np.asarray(ref, np.float32)).all()
 
 
+@pytest.mark.parametrize("shape", [(4, 64), (2, 8, 32)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layernorm_matches_reference_and_flax(shape, dtype):
+    import flax.linen as nn
+
+    from tf_yarn_tpu.ops.layernorm import layernorm, layernorm_reference
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32), dtype)
+    scale = jnp.asarray(rng.rand(shape[-1]).astype(np.float32))
+    bias = jnp.asarray(rng.randn(shape[-1]).astype(np.float32) * 0.1)
+    out = layernorm(x, scale, bias, eps=1e-12)
+    ref = layernorm_reference(x, scale, bias, eps=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+    )
+    assert out.dtype == x.dtype
+    ln = nn.LayerNorm(epsilon=1e-12)
+    flax_out = ln.apply(
+        {"params": {"scale": scale, "bias": bias}}, x.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(ref, np.float32), np.asarray(flax_out, np.float32),
+        atol=2e-2,
+    )
+
+
+def test_layernorm_grad_matches_reference():
+    from tf_yarn_tpu.ops.layernorm import layernorm, layernorm_reference
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+    scale = jnp.asarray(rng.rand(32).astype(np.float32))
+    bias = jnp.asarray(rng.randn(32).astype(np.float32) * 0.1)
+    g1 = jax.grad(
+        lambda x, s, b: layernorm(x, s, b).sum(), argnums=(0, 1, 2)
+    )(x, scale, bias)
+    g2 = jax.grad(
+        lambda x, s, b: layernorm_reference(x, s, b).sum(), argnums=(0, 1, 2)
+    )(x, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_quantize_int8_roundtrip():
     from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
 

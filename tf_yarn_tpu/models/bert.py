@@ -38,6 +38,9 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
+    # Fused pallas LayerNorm (ops/layernorm.py): one VMEM round-trip per
+    # norm. Param names match nn.LayerNorm — checkpoints swap freely.
+    fused_norms: bool = False
     # LoRA fields make BertConfig duck-compatible with transformer.LoraDense
     # (rank 0 = plain dense; raise for adapter fine-tuning).
     lora_rank: int = 0
@@ -59,6 +62,24 @@ class BertConfig:
         )
         defaults.update(overrides)
         return cls(**defaults)
+
+
+class BertNorm(nn.Module):
+    """LayerNorm with nn.LayerNorm-compatible params, routable through
+    the fused pallas kernel (config.fused_norms)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        from tf_yarn_tpu.ops import layernorm as ln_ops
+
+        d = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (d,), cfg.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (d,), cfg.param_dtype)
+        fn = ln_ops.layernorm if cfg.fused_norms else ln_ops.layernorm_reference
+        return fn(x, scale, bias, eps=cfg.norm_eps).astype(cfg.dtype)
 
 
 def _Dense(features: int, names: tuple, config: BertConfig, name: str):
@@ -88,17 +109,13 @@ class EncoderBlock(nn.Module):
             out.reshape(b, s, cfg.d_model)
         )
         out = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(out)
-        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(
-            x + out
-        )
+        x = BertNorm(cfg, name="attn_norm")(x + out)
 
         h = _Dense(cfg.d_ff, (EMBED, MLP), cfg, name="ffn_in")(x)
         h = nn.gelu(h)
         h = _Dense(cfg.d_model, (MLP, EMBED), cfg, name="ffn_out")(h)
         h = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(h)
-        return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="ffn_norm")(
-            x + h
-        )
+        return BertNorm(cfg, name="ffn_norm")(x + h)
 
 
 class BertEncoder(nn.Module):
@@ -132,7 +149,7 @@ class BertEncoder(nn.Module):
         x = x + pos_emb.astype(cfg.dtype)[None, :s]
         if segments is not None:
             x = x + seg_emb.astype(cfg.dtype)[segments]
-        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="embed_norm")(x)
+        x = BertNorm(cfg, name="embed_norm")(x)
         x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
         for i in range(cfg.n_layers):
             x = EncoderBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)

@@ -1,0 +1,51 @@
+"""Shared scaffolding for row-wise pallas norms (rmsnorm, layernorm).
+
+Both kernels reduce over the last dim only, so they share the same
+blocking: flatten leading dims to rows, tile rows into VMEM blocks (gcd
+fallback keeps the grid small on almost-divisible shapes), broadcast the
+[d]-shaped parameter vectors to every block. Keeping this in one place
+means a fix to the mechanics (block sizing, interpret default) lands in
+every kernel at once. groupnorm blocks per batch element (its reduction
+spans the spatial dims too) and intentionally does not use this.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def default_interpret() -> bool:
+    """pallas interpret mode everywhere but real TPU (CPU tests)."""
+    return jax.default_backend() != "tpu"
+
+
+def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
+    """Run `kernel(x_block, *vector_refs, o_ref)` over row blocks of x.
+
+    x: [..., d]; vectors: [d]-shaped operands shared by every block.
+    Returns an array of x's shape and dtype.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    rows = 1
+    for dim in orig_shape[:-1]:
+        rows *= dim
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        # Largest divisor <= block_rows keeps the grid small for
+        # almost-divisible shapes (vs collapsing straight to 1 row/step).
+        block_rows = math.gcd(rows, block_rows)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))]
+        + [pl.BlockSpec((d,), lambda i: (0,)) for _ in vectors],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x2, *vectors)
+    return out.reshape(orig_shape)

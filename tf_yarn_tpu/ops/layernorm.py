@@ -1,0 +1,85 @@
+"""Fused LayerNorm — pallas TPU kernel.
+
+The bert family's norm (models/bert.py: post-LN encoder, 2 norms/layer
+plus the embedding norm). Same single-VMEM-round-trip structure as
+ops/rmsnorm.py with the extra mean subtraction and bias; variance is
+computed two-pass on the in-VMEM block (mean first, then centered
+squares), so there is no E[x²]−mean² cancellation to clamp.
+
+Backward recomputes via the XLA reference (the rematerialization trade
+shared by ops/rmsnorm.py and ops/groupnorm.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def layernorm_reference(x, scale, bias, eps: float = 1e-12):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    centered = x32 - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    norm = centered * jax.lax.rsqrt(var + eps)
+    return (norm * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _layernorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    centered = x - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    y = centered * jax.lax.rsqrt(var + eps)
+    y = y * scale_ref[...].astype(jnp.float32)
+    y = y + bias_ref[...].astype(jnp.float32)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _layernorm_forward(x, scale, bias, eps, block_rows, interpret):
+    from tf_yarn_tpu.ops._rowwise import rowwise_call
+
+    return rowwise_call(
+        functools.partial(_layernorm_kernel, eps=eps),
+        x, (scale, bias), block_rows, interpret,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _layernorm(x, scale, bias, eps, block_rows, interpret):
+    return _layernorm_forward(x, scale, bias, eps, block_rows, interpret)
+
+
+def _layernorm_fwd(x, scale, bias, eps, block_rows, interpret):
+    return (_layernorm_forward(x, scale, bias, eps, block_rows, interpret),
+            (x, scale, bias))
+
+
+def _layernorm_bwd(eps, block_rows, interpret, residuals, g):
+    x, scale, bias = residuals
+    _, vjp = jax.vjp(
+        lambda x, s, b: layernorm_reference(x, s, b, eps), x, scale, bias)
+    return vjp(g)
+
+
+_layernorm.defvjp(_layernorm_fwd, _layernorm_bwd)
+
+
+def layernorm(
+    x: jax.Array,
+    scale: jax.Array,
+    bias: jax.Array,
+    eps: float = 1e-12,
+    block_rows: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused LayerNorm over the last dim; differentiable."""
+    if interpret is None:
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
+    return _layernorm(x, scale, bias, eps, block_rows, interpret)

@@ -13,7 +13,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 
 def rmsnorm_reference(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
@@ -30,31 +29,12 @@ def _rmsnorm_kernel(x_ref, scale_ref, o_ref, *, eps: float):
 
 
 def _rmsnorm_forward(x, scale, eps: float, block_rows: int, interpret: bool):
-    orig_shape = x.shape
-    d = orig_shape[-1]
-    rows = 1
-    for dim in orig_shape[:-1]:
-        rows *= dim
-    import math
+    from tf_yarn_tpu.ops._rowwise import rowwise_call
 
-    x2 = x.reshape(rows, d)
-    block_rows = min(block_rows, rows)
-    if rows % block_rows:
-        # Largest divisor <= block_rows keeps the grid small for
-        # almost-divisible shapes (vs collapsing straight to 1 row/step).
-        block_rows = math.gcd(rows, block_rows)
-    out = pl.pallas_call(
+    return rowwise_call(
         functools.partial(_rmsnorm_kernel, eps=eps),
-        grid=(rows // block_rows,),
-        in_specs=[
-            pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
-        interpret=interpret,
-    )(x2, scale)
-    return out.reshape(orig_shape)
+        x, (scale,), block_rows, interpret,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
@@ -84,5 +64,7 @@ def rmsnorm(
 ) -> jax.Array:
     """Fused RMSNorm over the last dim; differentiable."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
     return _rmsnorm(x, scale, eps, block_rows, interpret)

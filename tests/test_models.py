@@ -202,6 +202,23 @@ def test_bert_forward_and_train():
     assert np.isfinite(metrics["loss"])
 
 
+def test_vit_fused_layernorm_matches_unfused():
+    """ViTConfig stays duck-compatible with the shared EncoderBlock's
+    fused_norms routing; same param tree fused vs unfused."""
+    from tf_yarn_tpu.models import vit
+
+    images = jnp.asarray(
+        np.random.RandomState(0).randn(2, 32, 32, 3), jnp.float32)
+    model_ref = vit.ViT(vit.ViTConfig.tiny())
+    model_fused = vit.ViT(vit.ViTConfig.tiny(fused_norms=True))
+    variables = model_ref.init(jax.random.PRNGKey(0), images)
+    np.testing.assert_allclose(
+        np.asarray(model_ref.apply(variables, images), np.float32),
+        np.asarray(model_fused.apply(variables, images), np.float32),
+        atol=5e-2,
+    )
+
+
 def test_bert_fused_layernorm_matches_unfused():
     """fused_norms routes every LayerNorm through the pallas kernel with
     the SAME param tree (checkpoints swap freely) and matching logits."""

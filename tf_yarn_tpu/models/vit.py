@@ -18,7 +18,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from tf_yarn_tpu.models.bert import EncoderBlock, _Dense
+from tf_yarn_tpu.models.bert import BertNorm, EncoderBlock, _Dense
 from tf_yarn_tpu.models.transformer import EMBED, _partitioned
 
 
@@ -39,6 +39,9 @@ class ViTConfig:
     attention_impl: str = "xla"
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Fused pallas LayerNorm for the shared EncoderBlock + final_norm
+    # (duck-compat with BertConfig.fused_norms; ops/layernorm.py).
+    fused_norms: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -101,7 +104,7 @@ class ViT(nn.Module):
         x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
         for i in range(cfg.n_layers):
             x = EncoderBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
-        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
+        x = BertNorm(cfg, name="final_norm")(x)
         logits = _Dense(cfg.num_classes, (EMBED, None), cfg, name="head")(x[:, 0])
         return logits.astype(jnp.float32)
 

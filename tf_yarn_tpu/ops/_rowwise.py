@@ -33,6 +33,8 @@ def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
     rows = 1
     for dim in orig_shape[:-1]:
         rows *= dim
+    if rows == 0:
+        return x  # empty batch: nothing to normalize (0 % 0 would raise)
     x2 = x.reshape(rows, d)
     block_rows = min(block_rows, rows)
     if rows % block_rows:

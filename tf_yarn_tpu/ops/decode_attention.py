@@ -133,7 +133,9 @@ def int8_decode_attention(
     if softmax_scale is None:
         softmax_scale = head_dim**-0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
 
     kf = key_q.reshape(b, s, n_kv * head_dim)
     vf = value_q.reshape(b, s, n_kv * head_dim)

@@ -501,7 +501,9 @@ def flash_attention(
     if softmax_scale is None:
         softmax_scale = query.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
     return _flash(
         query, key, value, causal, softmax_scale, block_q, block_k, interpret
     )

@@ -141,5 +141,7 @@ def groupnorm(
     if c % groups or hw * c * 4 > _MAX_SLAB_BYTES:
         return groupnorm_reference(x, scale, bias, groups, eps)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
     return _groupnorm(x, scale, bias, groups, eps, interpret)

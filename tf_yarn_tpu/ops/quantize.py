@@ -47,7 +47,9 @@ def quantize_int8(
     import math
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
     if stochastic is None:
         stochastic = False  # deterministic by default; opt in on TPU
     if stochastic and interpret:

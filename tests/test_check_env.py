@@ -1,0 +1,25 @@
+"""The check_tpu_env diagnostic CLI actually diagnoses (reference ships
+check_hadoop_env as a console script; a broken doctor is worse than
+none — the local-run probe was silently broken for three rounds because
+nothing exercised it)."""
+
+from tf_yarn_tpu.bin import check_tpu_env
+
+
+def test_check_jax_honors_platform_override(monkeypatch):
+    monkeypatch.setenv("TPU_YARN_PLATFORM", "cpu")
+    assert check_tpu_env.check_jax()
+
+
+def test_check_coordination_round_trip():
+    assert check_tpu_env.check_coordination()
+
+
+def test_check_env_shipping_round_trip():
+    assert check_tpu_env.check_env_shipping()
+
+
+def test_check_local_run_end_to_end(monkeypatch):
+    monkeypatch.setenv("TPU_YARN_PLATFORM", "cpu")
+    monkeypatch.setenv("TPU_YARN_COORDD", "python")
+    assert check_tpu_env.check_local_run()

@@ -155,6 +155,39 @@ def test_layernorm_grad_matches_reference():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_kernels_handle_empty_batch():
+    """An empty eval shard / drained batch must flow through every pallas
+    entry point as an empty result, not a ZeroDivisionError or a
+    slice-size crash (review finding, round 4)."""
+    from tf_yarn_tpu.ops.decode_attention import int8_decode_attention
+    from tf_yarn_tpu.ops.flash_attention import flash_attention
+    from tf_yarn_tpu.ops.groupnorm import groupnorm
+    from tf_yarn_tpu.ops.layernorm import layernorm
+    from tf_yarn_tpu.ops.quantize import quantize_int8
+    from tf_yarn_tpu.ops.rmsnorm import rmsnorm
+
+    assert rmsnorm(jnp.zeros((0, 16)), jnp.ones((16,))).shape == (0, 16)
+    assert layernorm(
+        jnp.zeros((0, 16)), jnp.ones((16,)), jnp.zeros((16,))
+    ).shape == (0, 16)
+    assert groupnorm(
+        jnp.zeros((0, 4, 4, 8)), jnp.ones((8,)), jnp.zeros((8,)), 4
+    ).shape == (0, 4, 4, 8)
+    values, scales = quantize_int8(jnp.zeros((0, 16)))
+    assert values.shape == (0, 16) and scales.shape == (0, 1)
+    assert flash_attention(
+        jnp.zeros((0, 8, 2, 4)), jnp.zeros((0, 8, 2, 4)),
+        jnp.zeros((0, 8, 2, 4)),
+    ).shape == (0, 8, 2, 4)
+    out = int8_decode_attention(
+        jnp.zeros((0, 2, 4)),
+        jnp.zeros((0, 8, 2, 4), jnp.int8), jnp.zeros((0, 8, 2, 1)),
+        jnp.zeros((0, 8, 2, 4), jnp.int8), jnp.zeros((0, 8, 2, 1)),
+        jnp.int32(0),
+    )
+    assert out.shape == (0, 2, 4)
+
+
 def test_quantize_int8_roundtrip():
     from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
 

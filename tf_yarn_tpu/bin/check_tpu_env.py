@@ -19,8 +19,16 @@ _logger = logging.getLogger(__name__)
 
 def check_jax() -> bool:
     try:
+        import os
+
         import jax
 
+        platform = os.environ.get("TPU_YARN_PLATFORM")
+        if platform:
+            # The documented escape hatch (parallel/mesh.select_devices
+            # honors it too): lets the other checks run while a wedged
+            # accelerator relay would hang default device init forever.
+            jax.config.update("jax_platforms", platform)
         devices = jax.devices()
         print(f"OK   jax {jax.__version__}, backend={jax.default_backend()}, "
               f"devices={[str(d) for d in devices]}")
@@ -50,17 +58,59 @@ def check_coordination() -> bool:
         return False
 
 
+def check_env_shipping() -> bool:
+    """Round-trip the code-shipping path a remote launch relies on: zip
+    the installed package, stage it, and run unpack_cmd in a bare shell
+    whose PYTHONPATH starts empty — the import must come from the
+    unpacked copy (the reference's check ships a test file to HDFS and
+    reads it back; here the shipped artifact IS the code)."""
+    import os
+    import subprocess
+
+    from tf_yarn_tpu import packaging
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="check-env-ship-") as tmp:
+            staging = os.path.join(tmp, "staging")
+            hook = packaging.ship_env(staging, dest=os.path.join(tmp, "code"))
+            probe = (
+                f"{hook} && {sys.executable} -c "
+                "'import tf_yarn_tpu, sys; print(tf_yarn_tpu.__file__)'"
+            )
+            result = subprocess.run(
+                ["/bin/sh", "-c", probe],
+                capture_output=True, text=True, timeout=120,
+                env={k: v for k, v in os.environ.items()
+                     if k != "PYTHONPATH"},
+                cwd=tmp,
+            )
+            imported = result.stdout.strip()
+            assert result.returncode == 0, result.stderr.strip()[-300:]
+            assert imported.startswith(tmp), imported
+        print("OK   env shipping (zip -> stage -> unpack_cmd -> import "
+              "from shipped copy)")
+        return True
+    except Exception as exc:
+        print(f"FAIL env shipping: {exc}")
+        return False
+
+
 def check_local_run() -> bool:
     """Launch a real one-task run through the full driver path (the analog
     of the reference's remote 1-container check, check_hadoop_env.py:56-93)."""
     from tf_yarn_tpu.client import run_on_tpu
     from tf_yarn_tpu.topologies import TaskSpec
 
-    probe_file = tempfile.NamedTemporaryFile(delete=False)
+    import os
 
+    fd, probe_path = tempfile.mkstemp(prefix="check-tpu-env-")
+    os.close(fd)
+
+    # The closure must capture only the path STRING: a file object would
+    # poison the cloudpickle that ships experiment_fn to the task.
     def experiment_fn():
         def run(params):
-            with open(probe_file.name, "w") as fh:
+            with open(probe_path, "w") as fh:
                 fh.write(f"rank={params.rank}")
 
         return run
@@ -73,13 +123,18 @@ def check_local_run() -> bool:
             name="check_tpu_env",
             poll_every_secs=0.2,
         )
-        with open(probe_file.name) as fh:
+        with open(probe_path) as fh:
             assert fh.read() == "rank=0"
         print("OK   end-to-end local run (driver -> coordination -> task)")
         return True
     except Exception as exc:
         print(f"FAIL end-to-end local run: {exc}")
         return False
+    finally:
+        try:
+            os.unlink(probe_path)
+        except OSError:
+            pass
 
 
 def main() -> int:
@@ -89,7 +144,7 @@ def main() -> int:
     )
     args = parser.parse_args()
     logging.basicConfig(level=logging.WARNING)
-    ok = check_jax() & check_coordination()
+    ok = check_jax() & check_coordination() & check_env_shipping()
     if not args.skip_run:
         ok &= check_local_run()
     print("all checks passed" if ok else "some checks FAILED")

@@ -124,6 +124,8 @@ def int8_decode_attention(
 
     b, n_heads, head_dim = query.shape
     _, s, n_kv, _ = key_q.shape
+    if query.size == 0 or s == 0:  # empty batch or cache
+        return jnp.zeros(query.shape, query.dtype)
     group = n_heads // n_kv
     # Any S the cache can hold must decode at full tile width: the grid
     # rounds up and pallas pads the trailing partial block (dead positions

@@ -500,6 +500,8 @@ def flash_attention(
     """
     if softmax_scale is None:
         softmax_scale = query.shape[-1] ** -0.5
+    if query.size == 0:  # empty batch/sequence: nothing to attend over
+        return jnp.zeros(query.shape, query.dtype)
     if interpret is None:
         from tf_yarn_tpu.ops._rowwise import default_interpret
 

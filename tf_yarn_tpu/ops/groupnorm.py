@@ -138,7 +138,9 @@ def groupnorm(
     hw = 1
     for dim in x.shape[1:-1]:
         hw *= dim
-    if c % groups or hw * c * 4 > _MAX_SLAB_BYTES:
+    if x.shape[0] == 0:  # empty batch: a (0,)-grid pallas_call is invalid
+        return x
+    if c % groups or hw == 0 or hw * c * 4 > _MAX_SLAB_BYTES:
         return groupnorm_reference(x, scale, bias, groups, eps)
     if interpret is None:
         from tf_yarn_tpu.ops._rowwise import default_interpret

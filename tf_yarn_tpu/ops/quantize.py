@@ -59,6 +59,9 @@ def quantize_int8(
     rows = 1
     for dim in orig_shape[:-1]:
         rows *= dim
+    if rows == 0:  # empty batch: 0 % 0 below would raise
+        return (jnp.zeros(orig_shape, jnp.int8),
+                jnp.zeros(orig_shape[:-1] + (1,), jnp.float32))
     x2 = x.reshape(rows, d)
     block_rows = min(block_rows, rows)
     if rows % block_rows:

@@ -179,6 +179,12 @@ def test_kernels_handle_empty_batch():
         jnp.zeros((0, 8, 2, 4)), jnp.zeros((0, 8, 2, 4)),
         jnp.zeros((0, 8, 2, 4)),
     ).shape == (0, 8, 2, 4)
+    # Nonempty query over an EMPTY kv sequence (drained cross-attention
+    # source) is defined as zeros, not a zero-extent-grid crash.
+    assert flash_attention(
+        jnp.zeros((2, 8, 2, 4)), jnp.zeros((2, 0, 2, 4)),
+        jnp.zeros((2, 0, 2, 4)), causal=False,
+    ).shape == (2, 8, 2, 4)
     out = int8_decode_attention(
         jnp.zeros((0, 2, 4)),
         jnp.zeros((0, 8, 2, 4), jnp.int8), jnp.zeros((0, 8, 2, 1)),

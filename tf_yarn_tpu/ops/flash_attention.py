@@ -500,7 +500,10 @@ def flash_attention(
     """
     if softmax_scale is None:
         softmax_scale = query.shape[-1] ** -0.5
-    if query.size == 0:  # empty batch/sequence: nothing to attend over
+    if query.size == 0 or key.size == 0:
+        # Empty batch/sequence on either side: nothing to attend over
+        # (empty kv would mean softmax over zero positions — define the
+        # result as zeros rather than crash on a zero-extent grid).
         return jnp.zeros(query.shape, query.dtype)
     if interpret is None:
         from tf_yarn_tpu.ops._rowwise import default_interpret

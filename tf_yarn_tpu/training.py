@@ -324,6 +324,83 @@ def _make_input_iter(input_fn, start_step: int, logger):
     return iter(input_fn())
 
 
+class _ProfileWindow:
+    """jax.profiler capture controlled by env:
+
+    * ``TPU_YARN_PROFILE=<dir>`` — capture a trace into <dir>. Whole run
+      by default (the round-2 behavior).
+    * ``TPU_YARN_PROFILE_STEPS="A:B"`` — capture only steps [A, B), so a
+      long job's trace stays downloadable/readable (a 50k-step run's
+      full trace is gigabytes). Either bound may be empty ("100:" =
+      from 100 to the end). The train loop treats the window edges as
+      host boundaries, so steps_per_loop chunks never step over them —
+      the captured range is exact.
+
+    ``on_step(next_step)`` is called before the loop and after every
+    step advance; start/stop happen there and in the loop's cleanup.
+    """
+
+    def __init__(self):
+        self.dir = os.environ.get("TPU_YARN_PROFILE")
+        self.start_step = 0
+        self.stop_step = None
+        self.active = False
+        window = os.environ.get("TPU_YARN_PROFILE_STEPS", "")
+        if window:
+            start, _, stop = window.partition(":")
+            try:
+                # Parse both bounds BEFORE assigning either: a typo in
+                # one must not leave a half-applied window after the
+                # "ignoring" warning.
+                parsed_start = int(start) if start else 0
+                parsed_stop = int(stop) if stop else None
+            except ValueError:
+                _logger.warning(
+                    "ignoring malformed TPU_YARN_PROFILE_STEPS=%r "
+                    "(want 'A:B', e.g. '100:110')", window)
+            else:
+                self.start_step = parsed_start
+                self.stop_step = parsed_stop
+
+    def boundaries(self):
+        """Absolute steps where capture toggles — the train loop keeps
+        steps_per_loop chunks from crossing them, so a window strictly
+        inside a chunk can't be silently skipped."""
+        if not self.dir:
+            return ()
+        return tuple(
+            b for b in (self.start_step, self.stop_step)
+            if b is not None and b > 0
+        )
+
+    def on_step(self, next_step: int, state=None) -> None:
+        if not self.dir:
+            return
+        in_window = next_step >= self.start_step and (
+            self.stop_step is None or next_step < self.stop_step)
+        if in_window and not self.active:
+            from jax import profiler
+
+            profiler.start_trace(self.dir)
+            self.active = True
+            _logger.info("profiler capture started (step %d) -> %s",
+                         next_step, self.dir)
+        elif self.active and not in_window:
+            self.stop(state)
+
+    def stop(self, state=None) -> None:
+        if not self.active:
+            return
+        from jax import profiler
+
+        if state is not None:
+            # Flush in-flight device work so the trace covers it.
+            jax.block_until_ready(state.params)
+        profiler.stop_trace()
+        self.active = False
+        _logger.info("profiler trace written to %s", self.dir)
+
+
 class _UploadingTbWriter:
     """SummaryWriter against a remote model_dir: write event files to a
     local spool, upload the tree incrementally at checkpoint boundaries
@@ -604,12 +681,10 @@ def train_and_evaluate(
         from tf_yarn_tpu.data.prefetch import prefetch
 
         # Tracing (SURVEY §5: reference has coarse timers only; the
-        # idiomatic TPU upgrade is a jax.profiler capture per host).
-        profile_dir = os.environ.get("TPU_YARN_PROFILE")
-        if profile_dir:
-            from jax import profiler as _profiler
-
-            _profiler.start_trace(profile_dir)
+        # idiomatic TPU upgrade is a jax.profiler capture per host),
+        # optionally windowed to a step range so long jobs stay readable.
+        profile = _ProfileWindow()
+        profile.on_step(resume_step)
 
         batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
         batch = first_global
@@ -645,6 +720,12 @@ def train_and_evaluate(
             boundary = params_cfg.train_steps
             for every in host_cadences:
                 boundary = min(boundary, (at // every + 1) * every)
+            for absolute in profile.boundaries():
+                # Profiler toggles are absolute steps, not cadences; a
+                # chunk must not step over one or the window would be
+                # skipped/shifted.
+                if absolute > at:
+                    boundary = min(boundary, absolute)
             return boundary
 
         try:
@@ -684,6 +765,7 @@ def train_and_evaluate(
                 if not ran_chunk:
                     state, metrics = run_single(state, batch)
                     step += 1
+                profile.on_step(step, state)
                 if (
                     not input_exhausted
                     and step < params_cfg.train_steps
@@ -762,12 +844,7 @@ def train_and_evaluate(
         finally:
             # Unblock the prefetch producer and drop staged device batches.
             batch_iter.close()
-            if profile_dir:
-                from jax import profiler as _profiler
-
-                jax.block_until_ready(state.params)
-                _profiler.stop_trace()
-                _logger.info("profiler trace written to %s", profile_dir)
+            profile.stop(state)
 
         if not metrics_host:
             # Loop never ran (restored checkpoint already at train_steps):

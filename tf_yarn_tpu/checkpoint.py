@@ -212,14 +212,21 @@ def _commit_staged(local_ckpt: str, model_dir: str, step: int) -> None:
     fs_lib.rmtree(backup)
 
 
-def _write_staged(model_dir: str, step: int, snapshot: Any) -> None:
+def _write_staged(model_dir: str, step: int, snapshot_holder: list) -> None:
     """Serialize a host-numpy snapshot locally and commit it remotely.
     Runs only on the elected uploader (and, for the async writer, on its
-    worker thread)."""
+    worker thread).
+
+    `snapshot_holder` is a one-element list, emptied once the state is
+    on local disk: a bare argument would stay referenced by the
+    executor's work item (and the caller's frame) for the whole call, so
+    the host-RAM copy would sit pinned through the slow network upload —
+    the holder makes the release real, not cosmetic."""
     with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
         local = os.path.join(tmp, f"ckpt-{step}")
         with _local_checkpointer() as ckptr:
-            ckptr.save(local, snapshot, force=True)
+            ckptr.save(local, snapshot_holder[0], force=True)
+        snapshot_holder.clear()
         _commit_staged(local, model_dir, step)
 
 
@@ -227,7 +234,9 @@ def _staged_save(model_dir: str, step: int, state: Any) -> None:
     """Synchronous staged save (collective under multi-host)."""
     snapshot, uploader = _snapshot_for_staging(state)
     if uploader:
-        _write_staged(model_dir, step, snapshot)
+        holder = [snapshot]
+        del snapshot
+        _write_staged(model_dir, step, holder)
 
 
 @contextlib.contextmanager
@@ -320,12 +329,14 @@ class CheckpointWriter:
         snapshot, uploader = _snapshot_for_staging(state)
         if not uploader:
             return
+        holder = [snapshot]
+        del snapshot
         if self._executor is None:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-stage"
             )
         self._staged_futures.append(
-            self._executor.submit(_write_staged, model_dir, step, snapshot)
+            self._executor.submit(_write_staged, model_dir, step, holder)
         )
 
     def _raise_staged_errors(self, block: bool) -> None:

@@ -52,3 +52,27 @@ if __name__ == "__main__":
         experiment_fn, {"worker": TaskSpec(instances=1)}, name="llama_lora"
     )
     print("run metrics:", metrics)
+
+    # Deployment step: fold the trained adapters into the base weights —
+    # the merged tree serves under lora_rank=0 with zero adapter math.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+
+    from tf_yarn_tpu import checkpoint as ckpt_lib
+    from tf_yarn_tpu.models.transformer import Transformer, merge_lora
+
+    experiment = experiment_fn()
+    step = ckpt_lib.latest_checkpoint_step(MODEL_DIR)
+    assert step is not None, "no checkpoint written"
+    # Host restore: the ckpt was written by an 8-device worker mesh; the
+    # driver merges on its single CPU device (numpy, topology-free).
+    state = ckpt_lib.restore_checkpoint_host(MODEL_DIR, step)
+    # TrainState.params is the full variables dict ({"params": ...}).
+    merged = merge_lora(state["params"], experiment.model.config)
+    plain_cfg = dataclasses.replace(experiment.model.config, lora_rank=0)
+    import jax.numpy as jnp
+
+    logits = Transformer(plain_cfg).apply(merged, jnp.zeros((1, 8), jnp.int32))
+    print("merged adapter model serves plain:", logits.shape)

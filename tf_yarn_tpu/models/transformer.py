@@ -512,6 +512,37 @@ def lora_label_tree(params) -> Any:
     return jtu.tree_unflatten(treedef, [label(path) for path, _ in flat])
 
 
+def merge_lora(params, config) -> Any:
+    """Fold trained LoRA adapters into the base kernels for deployment:
+    every LoraDense's W becomes W + (alpha/rank)·A@B and the adapter
+    factors are dropped, so the result loads into the SAME architecture
+    with `lora_rank=0` — no adapter math at serving time, and the plain
+    checkpoint works with inference/generation unchanged. Accepts either
+    the full `{"params": ...}` variables dict or the inner params tree;
+    flax partitioning boxes on kernels are preserved."""
+    if getattr(config, "lora_rank", 0) <= 0:
+        return params
+    scale = config.lora_alpha / config.lora_rank
+
+    def _unbox(leaf):
+        return leaf.value if hasattr(leaf, "value") else leaf
+
+    def _walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {key: _walk(child) for key, child in node.items()}
+        if "kernel" in out and "lora_a" in out and "lora_b" in out:
+            kernel = out["kernel"]
+            delta = scale * (_unbox(out.pop("lora_a"))
+                             @ _unbox(out.pop("lora_b")))
+            merged = _unbox(kernel) + delta.astype(_unbox(kernel).dtype)
+            out["kernel"] = (kernel.replace_boxed(merged)
+                             if hasattr(kernel, "replace_boxed") else merged)
+        return out
+
+    return _walk(dict(params))
+
+
 def make_lora_optimizer(learning_rate: float = 1e-4, inner=None):
     """`inner` (default adamw) on LoRA params, frozen base (reference has
     no analog — LoRA is a BASELINE.json config 5 requirement)."""

@@ -125,3 +125,55 @@ def test_generate_with_int8_kv_cache():
     )
     assert out.shape == (1, 8)
     assert (np.asarray(out) >= 0).all()
+
+
+def test_top_p_sampling_stays_in_nucleus():
+    """Nucleus sampling never emits a token outside the smallest prefix
+    whose probability mass reaches top_p; the top token always stays
+    even when its own mass exceeds top_p."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.generate import _sample
+
+    # Distribution: p ~ [0.5, 0.3, 0.15, 0.05] -> top_p=0.6 keeps {0, 1}.
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]] * 64))
+    draws = _sample(
+        logits, jax.random.PRNGKey(0), temperature=1.0, top_k=None,
+        top_p=0.6,
+    )
+    assert set(np.asarray(draws).tolist()) <= {0, 1}, np.unique(draws)
+
+    # Degenerate nucleus: top token alone exceeds top_p -> still sampled.
+    peaked = jnp.log(jnp.array([[0.9, 0.05, 0.03, 0.02]] * 32))
+    draws = _sample(
+        peaked, jax.random.PRNGKey(1), temperature=1.0, top_k=None,
+        top_p=0.1,
+    )
+    assert set(np.asarray(draws).tolist()) == {0}
+
+    # top_p composes with temperature + top_k (smoke: no crash, valid ids).
+    draws = _sample(
+        logits, jax.random.PRNGKey(2), temperature=0.7, top_k=3, top_p=0.9,
+    )
+    assert np.asarray(draws).min() >= 0 and np.asarray(draws).max() < 4
+
+
+def test_generate_with_top_p():
+    import jax
+    import numpy as np
+
+    from tf_yarn_tpu.models.generate import generate
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig.tiny(max_seq_len=32)
+    model = Transformer(cfg)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+    out = generate(
+        model, variables, prompt, max_new_tokens=5,
+        temperature=0.8, top_p=0.9,
+    )
+    assert out.shape == (1, 8)
+    assert (np.asarray(out)[:, :3] == prompt).all()

@@ -135,6 +135,19 @@ def test_merge_lora_matches_adapter_model(scan_layers):
         atol=5e-2,
     )
 
+    # FrozenDict trees (older flax / frozen user code) must merge too —
+    # silently returning them untouched would serve base weights with the
+    # fine-tune missing.
+    import flax
+
+    frozen_merged = transformer.merge_lora(
+        flax.core.freeze(variables), cfg_lora)
+    frozen_names = [
+        "/".join(str(getattr(k, "key", "")) for k in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(frozen_merged)[0]
+    ]
+    assert not any("lora_" in n for n in frozen_names)
+
 
 def test_adafactor_optimizer_option():
     exp = transformer.make_experiment(

@@ -253,6 +253,7 @@ class InferenceExperiment:
     max_new_tokens: int = 128
     temperature: float = 0.0
     top_k: Optional[int] = None
+    top_p: Optional[float] = None
     eos_token: Optional[int] = None
     step: Optional[int] = None  # checkpoint step; None = latest
     # Multi-instance jobs whose input_fn ignores (shard, num_shards) fail

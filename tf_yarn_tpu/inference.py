@@ -119,6 +119,7 @@ def run_inference(experiment, runtime=None) -> dict:
                 max_new_tokens=experiment.max_new_tokens,
                 temperature=experiment.temperature,
                 top_k=experiment.top_k,
+                top_p=getattr(experiment, "top_p", None),
                 eos_token=experiment.eos_token,
             )
             sequences = np.asarray(sequences)

@@ -3,7 +3,7 @@
 Inference for the decoder family: one prefill pass writes the prompt into
 each layer's KV cache, then a jitted single-token step samples and extends
 the cache — O(1) attention work per new token instead of re-running the
-full sequence. Greedy, temperature, and top-k sampling.
+full sequence. Greedy, temperature, top-k, and top-p (nucleus) sampling.
 
 No reference analog (tf-yarn is a training launcher); provided because a
 complete model family needs an inference path.
@@ -17,14 +17,37 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(logits, rng, temperature: float, top_k: Optional[int]):
+def _sample(logits, rng, temperature: float, top_k: Optional[int],
+            top_p: Optional[float] = None):
     """logits [B, V] -> token ids [B]."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_k is not None or top_p is not None:
+        # One descending sort serves both filters — a second full-vocab
+        # sort per decode token would double the hot-path sort cost.
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k is not None:
+            kth = sorted_desc[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+            # Mirror the mask in sorted space so top_p renormalizes over
+            # the top_k-filtered distribution (value-based: ties at the
+            # threshold survive in both views).
+            sorted_desc = jnp.where(sorted_desc < kth, -1e30, sorted_desc)
+        if top_p is not None:
+            # Nucleus sampling: keep the smallest probability-sorted
+            # prefix whose mass reaches top_p; the keep-mask scatters
+            # back by comparing each logit to the cutoff logit
+            # (sort+cumsum, no gather/scatter ops — XLA-clean).
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cumulative = jnp.cumsum(probs, axis=-1)
+            # Positions strictly past the nucleus; the first token
+            # always stays (cumulative >= top_p only AFTER including it).
+            in_nucleus = cumulative - probs < top_p
+            cutoff_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)
+            cutoff = jnp.take_along_axis(
+                sorted_desc, cutoff_idx[:, None], axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -35,6 +58,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     seed: int = 0,
     eos_token: Optional[int] = None,
 ):
@@ -66,7 +90,8 @@ def generate(
     )
     cache = state["cache"]
     rng, prefill_rng = jax.random.split(rng)
-    next_token = _sample(logits[:, -1], prefill_rng, temperature, top_k)
+    next_token = _sample(
+        logits[:, -1], prefill_rng, temperature, top_k, top_p)
 
     @jax.jit
     def step(cache, token, rng):
@@ -74,7 +99,8 @@ def generate(
             {**params, "cache": cache}, token[:, None], decode=True,
             mutable=["cache"],
         )
-        return state["cache"], _sample(logits[:, -1], rng, temperature, top_k)
+        return state["cache"], _sample(
+            logits[:, -1], rng, temperature, top_k, top_p)
 
     tokens = [next_token]
     finished = jnp.zeros((b,), bool) if eos_token is not None else None

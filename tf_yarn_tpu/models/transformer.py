@@ -522,13 +522,17 @@ def merge_lora(params, config) -> Any:
     flax partitioning boxes on kernels are preserved."""
     if getattr(config, "lora_rank", 0) <= 0:
         return params
+    from collections.abc import Mapping
+
     scale = config.lora_alpha / config.lora_rank
 
     def _unbox(leaf):
         return leaf.value if hasattr(leaf, "value") else leaf
 
     def _walk(node):
-        if not isinstance(node, dict):
+        # Mapping, not dict: a FrozenDict tree must merge too, not come
+        # back untouched with the adapters silently dropped at serving.
+        if not isinstance(node, Mapping):
             return node
         out = {key: _walk(child) for key, child in node.items()}
         if "kernel" in out and "lora_a" in out and "lora_b" in out:

@@ -11,6 +11,7 @@ spans the spatial dims too) and intentionally does not use this.
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -51,3 +52,81 @@ def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
         interpret=interpret,
     )(x2, *vectors)
     return out.reshape(orig_shape)
+
+
+def make_sharded_op(local_fn, n_vectors: int, rule: str,
+                    need_replication: tuple, spec_filter):
+    """Wrap a local computation in `custom_partitioning` so pjit runs the
+    pallas kernel per shard instead of treating the custom call as
+    unpartitionable (which would replicate/gather the activation).
+
+    `rule`/`need_replication` feed the Shardy propagation rule;
+    `spec_filter(spec_list) -> spec_list` maps the observed activation
+    sharding to the one `partition` requests (XLA inserts a reshard when
+    they differ — e.g. a user's pjit put `tp` on a dim the kernel's
+    reduction spans). The [d]-shaped parameter vectors are always
+    replicated.
+
+    Differentiation never reaches the primitive: callers keep it inside
+    a custom_vjp forward whose backward recomputes via the XLA
+    reference. The wrapped op is NOT vmappable (custom_partitioning has
+    no batching rule) — unnecessary here, since every kernel accepts
+    arbitrary leading dims natively; reshape instead of vmap.
+    `local_fn(x, *vectors)` runs on each shard's local block.
+    """
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    @custom_partitioning
+    def wrapped(x, *vectors):
+        return local_fn(x, *vectors)
+
+    def partition(mesh, arg_shapes, result_shape):
+        x_sharding = arg_shapes[0].sharding
+        ndim = len(arg_shapes[0].shape)
+        spec = list(x_sharding.spec) + [None] * (ndim - len(x_sharding.spec))
+        x_sh = NamedSharding(mesh, PartitionSpec(*spec_filter(spec)))
+        vec_sh = NamedSharding(mesh, PartitionSpec(None))
+
+        def lower_fn(x, *vectors):
+            return local_fn(x, *vectors)
+
+        return mesh, lower_fn, x_sh, (x_sh,) + (vec_sh,) * n_vectors
+
+    wrapped.def_partition(
+        partition=partition,
+        sharding_rule=rule,
+        need_replication_factors=need_replication,
+    )
+    return wrapped
+
+
+def sharded_rowwise(local_fn, n_vectors: int):
+    """Partition-aware row-wise op: rows shard freely, the feature
+    (last) dim must be replicated."""
+
+    def keep_rows(spec):
+        return spec[:-1] + [None]
+
+    vec_rule = ", ".join(["d"] * n_vectors)
+    return make_sharded_op(
+        local_fn, n_vectors,
+        rule=f"... d, {vec_rule} -> ... d",
+        need_replication=("d",),
+        spec_filter=keep_rows,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_rowwise_call(kernel_factory, kernel_args, n_vectors: int,
+                         block_rows: int, interpret: bool):
+    """Cached partition-aware rowwise op. `kernel_factory(*kernel_args)`
+    builds the pallas kernel body; all keys must be hashable (floats,
+    ints, bools), so each distinct config creates exactly one
+    custom_partitioning primitive for the process lifetime."""
+    kernel = kernel_factory(*kernel_args)
+
+    def local_fn(x, *vectors):
+        return rowwise_call(kernel, x, vectors, block_rows, interpret)
+
+    return sharded_rowwise(local_fn, n_vectors)

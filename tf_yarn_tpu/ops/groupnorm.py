@@ -76,11 +76,14 @@ def _groupnorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *,
     o_ref[...] = y.reshape(x.shape).astype(o_ref.dtype)
 
 
-def _groupnorm_forward(x, scale, bias, groups, eps, interpret):
+def _groupnorm_local(x, scale, bias, groups, eps, interpret):
+    """The per-shard pallas call over [B_local, HW, C]."""
     b, c = x.shape[0], x.shape[-1]
     hw = 1
     for dim in x.shape[1:-1]:
         hw *= dim
+    if b == 0:
+        return x
     x3 = x.reshape(b, hw, c)
     out = pl.pallas_call(
         functools.partial(_groupnorm_kernel, groups=groups, eps=eps),
@@ -95,6 +98,33 @@ def _groupnorm_forward(x, scale, bias, groups, eps, interpret):
         interpret=interpret,
     )(x3, scale, bias)
     return out.reshape(x.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_groupnorm(ndim: int, groups: int, eps: float, interpret: bool):
+    """Partition-aware wrapper: the batch dim shards freely (each shard
+    norms its own images), spatial + channel dims must be replicated —
+    the per-(batch, group) reduction spans them. One primitive per
+    (ndim, groups, eps, interpret) config for the process lifetime."""
+    from tf_yarn_tpu.ops._rowwise import make_sharded_op
+
+    def local_fn(x, scale, bias):
+        return _groupnorm_local(x, scale, bias, groups, eps, interpret)
+
+    def keep_batch(spec):
+        return spec[:1] + [None] * (ndim - 1)
+
+    dims = " ".join(f"s{i}" for i in range(ndim - 2))
+    return make_sharded_op(
+        local_fn, 2,
+        rule=f"b {dims} c, c, c -> b {dims} c",
+        need_replication=tuple(f"s{i}" for i in range(ndim - 2)) + ("c",),
+        spec_filter=keep_batch,
+    )
+
+
+def _groupnorm_forward(x, scale, bias, groups, eps, interpret):
+    return _sharded_groupnorm(x.ndim, groups, eps, interpret)(x, scale, bias)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

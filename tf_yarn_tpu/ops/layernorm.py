@@ -40,13 +40,18 @@ def _layernorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *, eps: float):
     o_ref[...] = y.astype(o_ref.dtype)
 
 
-def _layernorm_forward(x, scale, bias, eps, block_rows, interpret):
-    from tf_yarn_tpu.ops._rowwise import rowwise_call
+def _make_layernorm_kernel(eps: float):
+    return functools.partial(_layernorm_kernel, eps=eps)
 
-    return rowwise_call(
-        functools.partial(_layernorm_kernel, eps=eps),
-        x, (scale, bias), block_rows, interpret,
-    )
+
+def _layernorm_forward(x, scale, bias, eps, block_rows, interpret):
+    # Partition-aware: under pjit the kernel runs on each shard's rows
+    # (ops/_rowwise.sharded_rowwise); plain rowwise pallas elsewhere.
+    from tf_yarn_tpu.ops._rowwise import sharded_rowwise_call
+
+    return sharded_rowwise_call(
+        _make_layernorm_kernel, (eps,), 2, block_rows, interpret
+    )(x, scale, bias)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -28,13 +28,18 @@ def _rmsnorm_kernel(x_ref, scale_ref, o_ref, *, eps: float):
     o_ref[...] = scaled.astype(o_ref.dtype)
 
 
-def _rmsnorm_forward(x, scale, eps: float, block_rows: int, interpret: bool):
-    from tf_yarn_tpu.ops._rowwise import rowwise_call
+def _make_rmsnorm_kernel(eps: float):
+    return functools.partial(_rmsnorm_kernel, eps=eps)
 
-    return rowwise_call(
-        functools.partial(_rmsnorm_kernel, eps=eps),
-        x, (scale,), block_rows, interpret,
-    )
+
+def _rmsnorm_forward(x, scale, eps: float, block_rows: int, interpret: bool):
+    # Partition-aware: under pjit the kernel runs on each shard's rows
+    # (ops/_rowwise.sharded_rowwise); plain rowwise pallas elsewhere.
+    from tf_yarn_tpu.ops._rowwise import sharded_rowwise_call
+
+    return sharded_rowwise_call(
+        _make_rmsnorm_kernel, (eps,), 1, block_rows, interpret
+    )(x, scale)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -54,44 +54,36 @@ def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
     return out.reshape(orig_shape)
 
 
-def make_sharded_op(local_fn, n_vectors: int, rule: str,
-                    need_replication: tuple, spec_filter):
+def make_sharded_op(local_fn, rule: str, need_replication: tuple,
+                    make_shardings):
     """Wrap a local computation in `custom_partitioning` so pjit runs the
     pallas kernel per shard instead of treating the custom call as
     unpartitionable (which would replicate/gather the activation).
 
-    `rule`/`need_replication` feed the Shardy propagation rule;
-    `spec_filter(spec_list) -> spec_list` maps the observed activation
-    sharding to the one `partition` requests (XLA inserts a reshard when
-    they differ — e.g. a user's pjit put `tp` on a dim the kernel's
-    reduction spans). The [d]-shaped parameter vectors are always
-    replicated.
+    `rule`/`need_replication` feed the Shardy propagation rule
+    (need_replication factors MUST be listed in rule-introduction
+    order); `make_shardings(mesh, arg_shapes, result_shape) ->
+    (arg_shardings, out_shardings)` is the policy deciding what each
+    shard actually sees — XLA inserts a reshard when the observed
+    sharding differs (e.g. a user's pjit put `tp` on a dim the kernel's
+    reduction spans). Used by the fused norms (rows shard, feature
+    replicated) and flash attention (batch shards, all else replicated).
 
     Differentiation never reaches the primitive: callers keep it inside
-    a custom_vjp forward whose backward recomputes via the XLA
-    reference. The wrapped op is NOT vmappable (custom_partitioning has
-    no batching rule) — unnecessary here, since every kernel accepts
-    arbitrary leading dims natively; reshape instead of vmap.
-    `local_fn(x, *vectors)` runs on each shard's local block.
+    a custom_vjp forward whose backward recomputes locally. The wrapped
+    op is NOT vmappable (custom_partitioning has no batching rule) —
+    unnecessary here, since the kernels accept arbitrary leading dims
+    natively; reshape instead of vmap.
     """
     from jax.experimental.custom_partitioning import custom_partitioning
-    from jax.sharding import NamedSharding, PartitionSpec
 
     @custom_partitioning
-    def wrapped(x, *vectors):
-        return local_fn(x, *vectors)
+    def wrapped(*args):
+        return local_fn(*args)
 
     def partition(mesh, arg_shapes, result_shape):
-        x_sharding = arg_shapes[0].sharding
-        ndim = len(arg_shapes[0].shape)
-        spec = list(x_sharding.spec) + [None] * (ndim - len(x_sharding.spec))
-        x_sh = NamedSharding(mesh, PartitionSpec(*spec_filter(spec)))
-        vec_sh = NamedSharding(mesh, PartitionSpec(None))
-
-        def lower_fn(x, *vectors):
-            return local_fn(x, *vectors)
-
-        return mesh, lower_fn, x_sh, (x_sh,) + (vec_sh,) * n_vectors
+        arg_shs, out_shs = make_shardings(mesh, arg_shapes, result_shape)
+        return mesh, local_fn, out_shs, arg_shs
 
     wrapped.def_partition(
         partition=partition,
@@ -101,19 +93,58 @@ def make_sharded_op(local_fn, n_vectors: int, rule: str,
     return wrapped
 
 
+def padded_spec(shape, sharding) -> list:
+    """The operand's PartitionSpec as a full-rank list (trailing dims
+    None-padded)."""
+    return list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+
+
 def sharded_rowwise(local_fn, n_vectors: int):
     """Partition-aware row-wise op: rows shard freely, the feature
-    (last) dim must be replicated."""
+    (last) dim and the [d] parameter vectors must be replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
 
-    def keep_rows(spec):
-        return spec[:-1] + [None]
+    def make_shardings(mesh, arg_shapes, result_shape):
+        spec = padded_spec(arg_shapes[0].shape, arg_shapes[0].sharding)
+        x_sh = NamedSharding(mesh, PartitionSpec(*spec[:-1], None))
+        vec_sh = NamedSharding(mesh, PartitionSpec(None))
+        return (x_sh,) + (vec_sh,) * n_vectors, x_sh
 
     vec_rule = ", ".join(["d"] * n_vectors)
     return make_sharded_op(
-        local_fn, n_vectors,
+        local_fn,
         rule=f"... d, {vec_rule} -> ... d",
         need_replication=("d",),
-        spec_filter=keep_rows,
+        make_shardings=make_shardings,
+    )
+
+
+def sharded_batch_only(local_fn, rule: str, need_replication: tuple):
+    """Partition-aware op where ONLY the leading (batch) dim shards:
+    every operand and result leads with it; all other dims replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def make_shardings(mesh, arg_shapes, result_shape):
+        first = padded_spec(arg_shapes[0].shape, arg_shapes[0].sharding)
+        batch_axis = first[0] if first else None
+
+        def batch_sh(shape):
+            if len(shape) <= 1:
+                # Parameter vectors don't carry a batch dim: replicate.
+                return NamedSharding(mesh, PartitionSpec(None))
+            return NamedSharding(
+                mesh, PartitionSpec(batch_axis, *([None] * (len(shape) - 1))))
+
+        arg_shs = tuple(batch_sh(a.shape) for a in arg_shapes)
+        if isinstance(result_shape, (list, tuple)):
+            out_shs = tuple(batch_sh(r.shape) for r in result_shape)
+        else:
+            out_shs = batch_sh(result_shape.shape)
+        return arg_shs, out_shs
+
+    return make_sharded_op(
+        local_fn, rule=rule, need_replication=need_replication,
+        make_shardings=make_shardings,
     )
 
 

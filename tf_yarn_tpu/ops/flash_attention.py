@@ -444,6 +444,64 @@ def _flash_backward(
 
 
 # ---------------------------------------------------------------------------
+# Partition awareness: under pjit the kernels run per batch shard
+# ---------------------------------------------------------------------------
+#
+# Without a sharding rule XLA treats the pallas custom calls as
+# unpartitionable and REPLICATES q/k/v on every device (measured:
+# out sharding collapses to PartitionSpec() under a dp mesh) — attention
+# would stop scaling with chips. The wrappers shard the batch dim and
+# replicate seq/head/feature (conservative: dp/fsdp layouts, the common
+# case; head-sharded tp attention should use the xla/ring/ulysses impls).
+# Differentiation never reaches the primitives: they live inside the
+# custom_vjp below. LSE residuals cross the boundary as [B, H, S, L] so
+# every operand/result leads with the batch dim the rule shards.
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_flash_fwd(causal, softmax_scale, block_q, block_k, interpret,
+                       save_residuals):
+    def local_fn(query, key, value):
+        out, lse = _flash_forward(
+            query, key, value, causal, softmax_scale, block_q, block_k,
+            interpret, save_residuals=save_residuals,
+        )
+        if not save_residuals:
+            return out
+        b, _, n_heads, _ = query.shape
+        return out, lse.reshape(b, n_heads, *lse.shape[1:])
+
+    # need_replication must list factors in rule-introduction order
+    # (b=0, s, h, d, then t, k from the key operand, then l).
+    if save_residuals:
+        rule = "b s h d, b t k d, b t k d -> b s h d, b h s l"
+        repl = ("s", "h", "d", "t", "k", "l")
+    else:
+        rule = "b s h d, b t k d, b t k d -> b s h d"
+        repl = ("s", "h", "d", "t", "k")
+    from tf_yarn_tpu.ops._rowwise import sharded_batch_only
+
+    return sharded_batch_only(local_fn, rule, repl)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_flash_bwd(causal, softmax_scale, block_q, block_k, interpret):
+    def local_fn(query, key, value, out, lse4, g):
+        b, h = lse4.shape[0], lse4.shape[1]
+        lse = lse4.reshape(b * h, *lse4.shape[2:])
+        return _flash_backward(
+            query, key, value, out, lse, g,
+            causal, softmax_scale, block_q, block_k, interpret,
+        )
+
+    rule = ("b s h d, b t k d, b t k d, b s h d, b h s l, b s h d "
+            "-> b s h d, b t k d, b t k d")
+    from tf_yarn_tpu.ops._rowwise import sharded_batch_only
+
+    return sharded_batch_only(local_fn, rule, ("s", "h", "d", "t", "k", "l"))
+
+
+# ---------------------------------------------------------------------------
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
 
@@ -452,27 +510,23 @@ def _flash_backward(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
-    out, _ = _flash_forward(
-        query, key, value, causal, softmax_scale, block_q, block_k, interpret,
-        save_residuals=False,
-    )
-    return out
+    return _sharded_flash_fwd(
+        causal, softmax_scale, block_q, block_k, interpret, False
+    )(query, key, value)
 
 
 def _flash_fwd(query, key, value, causal, softmax_scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(
-        query, key, value, causal, softmax_scale, block_q, block_k, interpret,
-        save_residuals=True,
-    )
-    return out, (query, key, value, out, lse)
+    out, lse4 = _sharded_flash_fwd(
+        causal, softmax_scale, block_q, block_k, interpret, True
+    )(query, key, value)
+    return out, (query, key, value, out, lse4)
 
 
 def _flash_bwd(causal, softmax_scale, block_q, block_k, interpret, residuals, g):
-    query, key, value, out, lse = residuals
-    return _flash_backward(
-        query, key, value, out, lse, g,
-        causal, softmax_scale, block_q, block_k, interpret,
-    )
+    query, key, value, out, lse4 = residuals
+    return _sharded_flash_bwd(
+        causal, softmax_scale, block_q, block_k, interpret
+    )(query, key, value, out, lse4, g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

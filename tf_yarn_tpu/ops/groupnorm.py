@@ -106,20 +106,16 @@ def _sharded_groupnorm(ndim: int, groups: int, eps: float, interpret: bool):
     norms its own images), spatial + channel dims must be replicated —
     the per-(batch, group) reduction spans them. One primitive per
     (ndim, groups, eps, interpret) config for the process lifetime."""
-    from tf_yarn_tpu.ops._rowwise import make_sharded_op
+    from tf_yarn_tpu.ops._rowwise import sharded_batch_only
 
     def local_fn(x, scale, bias):
         return _groupnorm_local(x, scale, bias, groups, eps, interpret)
 
-    def keep_batch(spec):
-        return spec[:1] + [None] * (ndim - 1)
-
     dims = " ".join(f"s{i}" for i in range(ndim - 2))
-    return make_sharded_op(
-        local_fn, 2,
+    return sharded_batch_only(
+        local_fn,
         rule=f"b {dims} c, c, c -> b {dims} c",
         need_replication=tuple(f"s{i}" for i in range(ndim - 2)) + ("c",),
-        spec_filter=keep_batch,
     )
 
 

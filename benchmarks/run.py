@@ -189,28 +189,37 @@ def bench_resnet50(tpu: bool):
 
 def bench_vit_base(tpu: bool):
     """ViT-B/16 on 224px images — encoder-stack vision throughput
-    (transformer-native counterpart of the resnet50 config)."""
+    (transformer-native counterpart of the resnet50 config). On TPU the
+    fused pallas LayerNorm rides as an A/B variant."""
     import numpy as np
     import optax
 
     from tf_yarn_tpu.benchmark import measure_throughput
     from tf_yarn_tpu.models import common, vit
 
-    config = vit.ViTConfig.base16() if tpu else vit.ViTConfig.tiny()
     batch = 128 if tpu else 8
-    size = config.image_size
     rng = np.random.RandomState(0)
-    model = vit.ViT(config)
-    return measure_throughput(
-        model,
-        common.classification_loss,
-        optax.adamw(3e-4),
-        {
-            "x": rng.randn(batch, size, size, 3).astype(np.float32),
-            "y": rng.randint(0, config.num_classes, batch).astype(np.int32),
-        },
-        steps=10 if tpu else 5,
-    )
+
+    def run_one(fused):
+        config = (vit.ViTConfig.base16(fused_norms=fused) if tpu
+                  else vit.ViTConfig.tiny(fused_norms=fused))
+        size = config.image_size
+        model = vit.ViT(config)
+        return measure_throughput(
+            model,
+            common.classification_loss,
+            optax.adamw(3e-4),
+            {
+                "x": rng.randn(batch, size, size, 3).astype(np.float32),
+                "y": rng.randint(
+                    0, config.num_classes, batch).astype(np.int32),
+            },
+            steps=10 if tpu else 5,
+        )
+
+    variants = ([("base", False), ("fused_ln", True)] if tpu
+                else [("base", False)])
+    return _best_of_variants(variants, run_one)
 
 
 def bench_llama_lora(tpu: bool):

@@ -146,6 +146,48 @@ def test_ship_files_contains_package():
     assert os.path.exists(os.path.join(entries["tf_yarn_tpu"], "client.py"))
 
 
+def test_ship_files_includes_editable_roots_minus_caches(tmp_path, monkeypatch):
+    """A pip-editable project's sys.path root ships child-by-child (the
+    workdir becomes the import root), with VCS/cache trees pruned."""
+    root = tmp_path / "proj_src"
+    (root / "mypkg").mkdir(parents=True)
+    (root / "mypkg" / "__init__.py").write_text("")
+    (root / ".git").mkdir()
+    (root / ".git" / "HEAD").write_text("ref")
+    (root / "node_modules").mkdir()
+    monkeypatch.setattr(
+        packaging, "get_editable_requirements",
+        lambda: {"mypkg": str(root)},
+    )
+    entries = packaging.ship_files()
+    assert entries["mypkg"] == str(root / "mypkg")
+    assert ".git" not in entries and "node_modules" not in entries
+    assert "tf_yarn_tpu" in entries  # the framework itself always ships
+
+
+def test_ship_env_ships_editables_flat(tmp_path, monkeypatch):
+    """ship_env stages editable roots as separate zips whose contents
+    extract flat into the same dest (sys.path-root semantics)."""
+    root = tmp_path / "proj_src"
+    (root / "mypkg").mkdir(parents=True)
+    (root / "mypkg" / "__init__.py").write_text("VALUE = 7")
+    monkeypatch.setattr(
+        packaging, "get_editable_requirements",
+        lambda: {"mypkg": str(root)},
+    )
+    staging = tmp_path / "staging"
+    hook = packaging.ship_env(str(staging))
+    zips = sorted(p.name for p in staging.iterdir() if p.suffix == ".zip")
+    assert len(zips) == 2  # tf_yarn_tpu + the editable project
+    names = set()
+    for name in zips:
+        with zipfile.ZipFile(staging / name) as zf:
+            names.update(zf.namelist())
+    assert "mypkg/__init__.py" in names        # flat: dest is the root
+    assert any(n.startswith("tf_yarn_tpu/") for n in names)
+    assert hook.count("extractall") == 2
+
+
 def test_upload_dir_delegates_to_fs(tmp_path):
     # One walk-and-copy implementation (VERDICT r3 weak #5): both entry
     # points produce identical trees.

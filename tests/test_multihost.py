@@ -126,10 +126,15 @@ def test_preemption_drain_agreed_across_hosts(tmp_path):
     assert steps[0] % 3 == 0, steps
 
 
-def _staged_remote_experiment_fn(remote_base: str, train_steps: int):
+def _staged_remote_experiment_fn(
+    remote_base: str, train_steps: int, probe_dir: str = None
+):
     """Experiment against a registered fake-remote scheme (the staged
     hdfs://-class path): gather-to-host-0 checkpointing under a real
-    2-process world (VERDICT r3 item 6)."""
+    2-process world (VERDICT r3 item 6). With `probe_dir`, every
+    _snapshot_for_staging call records (uploader, held_full_snapshot) so
+    the test can assert the non-uploader never materializes the full
+    state (VERDICT r4 weak #4)."""
 
     def experiment_fn():
         import optax
@@ -140,6 +145,22 @@ def _staged_remote_experiment_fn(remote_base: str, train_steps: int):
         from tf_yarn_tpu.parallel.mesh import MeshSpec
 
         from pyarrow import fs as pafs
+
+        if probe_dir:
+            import jax
+
+            from tf_yarn_tpu import checkpoint as ckpt_lib
+
+            orig = ckpt_lib._snapshot_for_staging
+
+            def probed(state, **kwargs):
+                snap, uploader = orig(state, **kwargs)
+                path = f"{probe_dir}/snap-{jax.process_index()}"
+                with open(path, "a") as fh:
+                    fh.write(f"uploader={uploader} held_full={snap is not None}\n")
+                return snap, uploader
+
+            ckpt_lib._snapshot_for_staging = probed
 
         local = pafs.LocalFileSystem()
         fs_lib.register_scheme(
@@ -170,14 +191,27 @@ def test_multihost_staged_remote_checkpointing(tmp_path):
     import os
 
     remote_base = str(tmp_path / "fake_remote")
+    probe_dir = str(tmp_path / "probe")
     os.makedirs(remote_base)
+    os.makedirs(probe_dir)
 
     run_on_tpu(
-        _staged_remote_experiment_fn(remote_base, train_steps=6),
+        _staged_remote_experiment_fn(
+            remote_base, train_steps=6, probe_dir=probe_dir),
         {"worker": TaskSpec(instances=2)},
         env={"TPU_YARN_PLATFORM": "cpu"},
         poll_every_secs=0.3,
     )
+    # Host 0 (the elected uploader) held the full gathered snapshot on
+    # every save; host 1 NEVER did — its peak is one streamed leaf.
+    with open(os.path.join(probe_dir, "snap-0")) as fh:
+        lines0 = fh.read().splitlines()
+    with open(os.path.join(probe_dir, "snap-1")) as fh:
+        lines1 = fh.read().splitlines()
+    assert lines0 and all(
+        ln == "uploader=True held_full=True" for ln in lines0), lines0
+    assert lines1 and all(
+        ln == "uploader=False held_full=False" for ln in lines1), lines1
     listed = sorted(
         name for name in os.listdir(os.path.join(remote_base, "model"))
     )

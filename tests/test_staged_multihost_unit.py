@@ -1,0 +1,120 @@
+"""Agreement + leaf-streaming logic of checkpoint._snapshot_for_staging
+under a MOCKED multi-host world (single interpreter; the real 2-process
+execution lives in tests/test_multihost.py). Covers the divergence bugs
+the advisor flagged in round 4: an unagreed error re-raise wedging peers
+in the gather, and host-local leaves being shape-corrupted by
+process_allgather (reference deployment surface:
+/root/reference/tf_yarn/pytorch/model_ckpt.py:31-73)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+
+
+class _FakeWorld:
+    """Pretend this interpreter is host `index` of `count`, with the
+    other hosts' agreement flags fixed at `peer_flags`."""
+
+    def __init__(
+        self, monkeypatch, peer_flags=(1, 0, 2**40), index=0, count=2
+    ):
+        from jax.experimental import multihost_utils
+
+        self.tiled_gathers = []
+        peer = np.array(peer_flags, np.int64)
+
+        def fake_allgather(x, tiled=False):
+            if not tiled:  # the [fits, error, batch budget] agreement
+                return np.stack([np.asarray(x), peer])
+            self.tiled_gathers.append(x)
+            return jax.tree_util.tree_map(np.asarray, x)
+
+        monkeypatch.setattr(jax, "process_count", lambda: count)
+        monkeypatch.setattr(jax, "process_index", lambda: index)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", fake_allgather
+        )
+
+
+def test_peer_error_aborts_before_any_gather(monkeypatch):
+    """A peer's pending-upload-error bit must abort THIS host before it
+    enters the first leaf collective (else: cross-fleet wedge)."""
+    world = _FakeWorld(monkeypatch, peer_flags=(1, 1, 2**40), index=1)
+    with pytest.raises(ckpt_lib.PeerStagedFailure):
+        ckpt_lib._snapshot_for_staging({"w": np.ones((4,), np.float32)})
+    assert world.tiled_gathers == []
+
+
+def test_error_owner_returns_for_reraise(monkeypatch):
+    """The host that owns the failed future gets (None, uploader) back so
+    its caller re-raises the REAL exception — no gathers happen."""
+    world = _FakeWorld(monkeypatch, peer_flags=(1, 0, 2**40), index=0)
+    snap, uploader = ckpt_lib._snapshot_for_staging(
+        {"w": np.ones((4,), np.float32)}, local_error=True
+    )
+    assert snap is None and uploader is True
+    assert world.tiled_gathers == []
+
+
+def test_ram_gate_binds_full_snapshot_only_on_uploader(monkeypatch):
+    """Same tight RAM on both hosts: the uploader (holds the whole
+    snapshot) must raise; a non-uploader (holds one leaf at a time)
+    passes."""
+    state = {f"w{i}": np.zeros(256, np.float32) for i in range(100)}
+    # ~100 KB total, 1 KB max leaf; "available" 50 KB (gate is avail//2).
+    monkeypatch.setattr(ckpt_lib, "_host_available_ram", lambda: 50_000)
+
+    _FakeWorld(monkeypatch, index=0)
+    with pytest.raises(ValueError, match="uploader host's RAM"):
+        ckpt_lib._snapshot_for_staging(state)
+
+    _FakeWorld(monkeypatch, index=1)
+    snap, uploader = ckpt_lib._snapshot_for_staging(state)
+    assert snap is None and uploader is False
+
+
+def test_host_local_leaves_pass_through_unchanged(monkeypatch):
+    """numpy / scalar / fully-addressable leaves must NOT go through
+    process_allgather (which would concatenate copies along axis 0 and
+    stack scalars, corrupting the restore shape): the uploader keeps its
+    own value, shapes intact."""
+    world = _FakeWorld(monkeypatch, index=0)
+    state = {
+        "np_leaf": np.full((3, 2), 7.0, np.float32),
+        "scalar": 5,
+        "jax_local": jax.device_put(np.arange(4.0, dtype=np.float32)),
+    }
+    snap, uploader = ckpt_lib._snapshot_for_staging(state)
+    assert uploader is True
+    # Nothing was gathered: every leaf here is host-local.
+    assert world.tiled_gathers == []
+    assert snap["np_leaf"].shape == (3, 2)
+    assert snap["scalar"] == 5
+    np.testing.assert_array_equal(
+        np.asarray(snap["jax_local"]), np.arange(4.0, dtype=np.float32)
+    )
+
+
+def test_gather_batches_bound_ram_not_collective_count():
+    """Leaves group into budget-bounded batches (one collective per
+    batch, not per leaf); an over-budget leaf still gathers whole."""
+    sized = [(0, 40), (1, 40), (2, 40), (3, 250), (4, 10), (5, 10)]
+    assert ckpt_lib._plan_gather_batches(sized, budget=100) == [
+        [0, 1], [2], [3], [4, 5]
+    ]
+    assert ckpt_lib._plan_gather_batches([], budget=100) == []
+    # A huge budget means exactly one collective for the whole state.
+    assert ckpt_lib._plan_gather_batches(sized, budget=10**9) == [
+        [0, 1, 2, 3, 4, 5]
+    ]
+
+
+def test_non_uploader_retains_nothing(monkeypatch):
+    _FakeWorld(monkeypatch, index=1)
+    snap, uploader = ckpt_lib._snapshot_for_staging(
+        {"w": np.ones((8, 8), np.float32)}
+    )
+    assert snap is None and uploader is False

@@ -20,13 +20,22 @@ paths:
   writes a local temp dir, the tree is uploaded to
   ``.staging-ckpt-<step>`` (invisible to discovery) and renamed into
   place, so pollers only ever see committed checkpoints. Under multi-host
-  the global state is first gathered to every host
-  (``multihost_utils.process_allgather``) and host 0 alone stages +
-  uploads one complete checkpoint — the reference's HDFS ``model_dir``
-  with multi-container jobs (reference: pytorch/model_ckpt.py:31-44,
-  tensorflow/tasks/evaluator_task.py:38-51). Gated on the gathered state
-  fitting in host RAM; models too big for one host need a filesystem
-  orbax can target directly (shared mount or gs://).
+  the global state is streamed LEAF BY LEAF through
+  ``multihost_utils.process_allgather`` and only host 0 (the elected
+  uploader) retains the gathered leaves and stages + uploads one
+  complete checkpoint — the reference's HDFS ``model_dir`` with
+  multi-container jobs (reference: pytorch/model_ckpt.py:31-44,
+  tensorflow/tasks/evaluator_task.py:38-51). The full snapshot only ever
+  materializes on the uploader: every other host's peak extra RAM is one
+  gather batch (<= min(256 MB, a quarter of the tightest host's
+  available RAM), plus one leaf if a single leaf exceeds that),
+  immediately released. (The allgather still moves each leaf to
+  every host — XLA has no gather-to-one-process collective and
+  cross-host reshard to a device subset is unsupported outside the TFRT
+  TPU runtime — but the *retention* is host-0-only.) Gated on the
+  snapshot fitting in the uploader's RAM and the largest leaf fitting
+  everywhere; models too big for that need a filesystem orbax can target
+  directly (shared mount or gs://).
 """
 
 from __future__ import annotations
@@ -90,59 +99,153 @@ def _host_available_ram() -> int:
         return 0
 
 
-def _state_nbytes(state: Any) -> int:
-    """Global byte size of a pytree of arrays (jax.Array .size is the
-    GLOBAL element count, so this prices the gathered snapshot)."""
-    import jax
-
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(state):
-        size = getattr(leaf, "size", None)
-        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
-        if size and itemsize:
-            total += int(size) * int(itemsize)
-    return total
+def _leaf_nbytes(leaf: Any) -> int:
+    """Global byte size of one array leaf (jax.Array .size is the GLOBAL
+    element count, so this prices the gathered copy)."""
+    size = getattr(leaf, "size", None)
+    itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+    if size and itemsize:
+        return int(size) * int(itemsize)
+    return 0
 
 
-def _snapshot_for_staging(state: Any):
-    """(host-numpy snapshot, am_I_the_uploader).
+class PeerStagedFailure(RuntimeError):
+    """Raised on hosts that did NOT own a failed background staged upload
+    when the owning host reports one — every host leaves the save
+    together instead of the owner raising while the rest wedge in the
+    gather collective."""
+
+
+# Per-collective byte budget for the leaf-streaming gather: leaves are
+# grouped into batches of up to this many bytes so a state with
+# thousands of small leaves (typical optimizer pytrees) doesn't pay one
+# cross-host collective per leaf, while a non-uploader's peak retained
+# RAM stays bounded by one batch. Tightened further by the agreed
+# per-host RAM-derived budget below.
+_GATHER_BATCH_BYTES = 256 << 20
+
+
+def _plan_gather_batches(sized_indices, budget: int):
+    """Group (leaf index, nbytes) pairs into batches of <= budget bytes
+    each (a single over-budget leaf still forms its own batch — it must
+    gather whole). Pure so every host computes identical boundaries."""
+    batches: list = []
+    current: list = []
+    current_bytes = 0
+    for index, nbytes in sized_indices:
+        if current and current_bytes + nbytes > budget:
+            batches.append(current)
+            current, current_bytes = [], 0
+        current.append(index)
+        current_bytes += nbytes
+    if current:
+        batches.append(current)
+    return batches
+
+
+def _snapshot_for_staging(state: Any, local_error: bool = False):
+    """(host-numpy snapshot or None, am_I_the_uploader).
 
     Single-host: a device_get copy (preserves the train loop's donation
     guarantee — the caller may overwrite device buffers immediately).
-    Multi-host: gather the GLOBAL state to every host and elect host 0 to
-    stage + upload one complete checkpoint (the reference's HDFS
-    model_dir deployment, pytorch/model_ckpt.py:31-44). This is a
-    collective: every process must call it. Fail-fast when the gathered
-    state cannot fit in host RAM — better a clear error at save time than
-    an OOM kill mid-upload."""
+    Multi-host: stream the GLOBAL state leaf-by-leaf; only host 0 (the
+    elected uploader) keeps the gathered leaves and later stages +
+    uploads one complete checkpoint (the reference's HDFS model_dir
+    deployment, pytorch/model_ckpt.py:31-44). Every other host returns
+    ``(None, False)`` and never holds more than one gathered BATCH
+    (budget-bounded, see _GATHER_BATCH_BYTES) at a time. This is a
+    collective: every process must call it.
+
+    All divergent decisions are AGREED before anyone enters the first
+    leaf gather — a host that raises while its peers enter the
+    collective would wedge the job in an allgather instead of failing
+    with a message. Three agreed bits:
+
+    * ``local_error`` — the caller (host 0's async writer) has a pending
+      upload failure to surface; peers raise PeerStagedFailure so the
+      whole fleet leaves save() together.
+    * uploader RAM fit — the full snapshot materializes only on host 0,
+      so only host 0's RAM must fit it…
+    * per-leaf RAM fit — …while every host must briefly fit the largest
+      single leaf.
+    """
     import jax
 
     if jax.process_count() > 1:
         import numpy as np
         from jax.experimental import multihost_utils
 
-        nbytes = _state_nbytes(state)
+        uploader = jax.process_index() == 0
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        nbytes = sum(_leaf_nbytes(leaf) for leaf in leaves)
+        max_leaf = max((_leaf_nbytes(leaf) for leaf in leaves), default=0)
         avail = _host_available_ram()
-        fits = 0 if (avail and nbytes > avail // 2) else 1
-        # The fit decision must be AGREED before anyone enters the gather:
-        # hosts see different MemAvailable, and one host raising while the
-        # others enter the collective would wedge the job in an allgather
-        # instead of failing with this message.
-        all_fit = bool(np.min(
-            multihost_utils.process_allgather(np.int32(fits))))
+        need = (nbytes + max_leaf) if uploader else max_leaf
+        fits = 0 if (avail and need > avail // 2) else 1
+        # Batch budget must be IDENTICAL on every host (different batch
+        # boundaries would desynchronize the collectives), so each host
+        # offers a RAM-derived cap and the fleet takes the minimum.
+        my_budget = _GATHER_BATCH_BYTES
+        if avail:
+            my_budget = min(my_budget, avail // 4)
+        flags = multihost_utils.process_allgather(
+            np.array([fits, int(local_error), my_budget], dtype=np.int64))
+        all_fit = bool(np.min(flags[..., 0]))
+        any_error = bool(np.max(flags[..., 1]))
+        batch_budget = int(np.min(flags[..., 2]))
+        if any_error:
+            if local_error:
+                # The caller owns the real exception and re-raises it.
+                return None, uploader
+            raise PeerStagedFailure(
+                "a peer host reported a failed background staged "
+                "checkpoint upload; aborting this save everywhere"
+            )
         if not all_fit:
             raise ValueError(
                 f"staged remote checkpointing gathers the full state "
-                f"({nbytes / 1e9:.2f} GB) to host RAM, and at least one "
-                f"host (this one has {avail / 1e9:.2f} GB available) "
-                "cannot fit it. Use a model_dir orbax can write directly "
-                "— a shared mount or gs:// — so each host streams only "
-                "its own shards."
+                f"({nbytes / 1e9:.2f} GB) to the uploader host's RAM "
+                f"(largest leaf {max_leaf / 1e9:.2f} GB on every host), "
+                f"and at least one host (this one has {avail / 1e9:.2f} "
+                "GB available) cannot fit its share. Use a model_dir "
+                "orbax can write directly — a shared mount or gs:// — so "
+                "each host streams only its own shards."
             )
-        # tiled=True: reassemble each global array (shards concatenated in
-        # place) rather than stacking one copy per process.
-        snapshot = multihost_utils.process_allgather(state, tiled=True)
-        return snapshot, jax.process_index() == 0
+        gathered: list = [None] * len(leaves)
+        gatherable = []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                gatherable.append((i, _leaf_nbytes(leaf)))
+            elif uploader:
+                # Host-local leaf (numpy, python scalar, fully-addressable
+                # array): process_allgather would CONCATENATE copies along
+                # axis 0 / stack scalars, silently corrupting the
+                # checkpoint shape on restore — pass the uploader's own
+                # value through unchanged instead. Same branch on every
+                # host (leaf types are SPMD-identical), so no collective
+                # skew.
+                gathered[i] = (
+                    jax.device_get(leaf)
+                    if isinstance(leaf, jax.Array)
+                    else leaf
+                )
+        for batch in _plan_gather_batches(gatherable, batch_budget):
+            # tiled=True: reassemble each global array (shards
+            # concatenated in place) rather than stacking one copy per
+            # process. One collective per batch, not per leaf.
+            values = multihost_utils.process_allgather(
+                [leaves[i] for i in batch], tiled=True)
+            if uploader:
+                for i, value in zip(batch, values):
+                    gathered[i] = value
+            del values  # non-uploaders release each batch immediately
+        if not uploader:
+            return None, False
+        return jax.tree_util.tree_unflatten(treedef, gathered), True
+    if local_error:
+        # The caller raises the pending upload failure right after this
+        # returns — don't build a full host-RAM snapshot just to drop it.
+        return None, True
     snapshot = jax.tree_util.tree_map(
         lambda leaf: jax.device_get(leaf)
         if isinstance(leaf, jax.Array)
@@ -324,9 +427,16 @@ class CheckpointWriter:
 
         # Backpressure: at most one upload in flight. Each snapshot pins a
         # full host-RAM copy of the state; letting them queue behind a
-        # slow link would grow memory without bound.
-        self._raise_staged_errors(block=True)
-        snapshot, uploader = _snapshot_for_staging(state)
+        # slow link would grow memory without bound. The error is only
+        # COLLECTED here — raising before the collective would leave the
+        # peers wedged in the gather; _snapshot_for_staging agrees the
+        # error bit across hosts so everyone aborts together, then the
+        # owning host re-raises the real exception.
+        pending = self._collect_staged_errors(block=True)
+        snapshot, uploader = _snapshot_for_staging(
+            state, local_error=pending is not None)
+        if pending is not None:
+            raise pending
         if not uploader:
             return
         holder = [snapshot]
@@ -339,11 +449,10 @@ class CheckpointWriter:
             self._executor.submit(_write_staged, model_dir, step, holder)
         )
 
-    def _raise_staged_errors(self, block: bool) -> None:
-        """Surface failures of background staged saves to the caller (an
-        upload failure from save(N) raises from the next save()/wait()).
-        Settled futures leave the queue even when raising, so one failure
-        is reported once — not re-raised by every later call."""
+    def _collect_staged_errors(self, block: bool):
+        """First failure of a background staged save, or None. Settled
+        futures leave the queue even when failing, so one failure is
+        reported once — not re-surfaced by every later call."""
         pending, errors = [], []
         for future in self._staged_futures:
             if block or future.done():
@@ -353,23 +462,43 @@ class CheckpointWriter:
             else:
                 pending.append(future)
         self._staged_futures = pending
-        if errors:
-            raise errors[0]
+        return errors[0] if errors else None
+
+    def _raise_staged_errors(self, block: bool) -> None:
+        """Surface failures of background staged saves to the caller (an
+        upload failure from save(N) raises from the next save()/wait()).
+        Only for non-collective call sites (wait/close) — inside save()
+        the error must be agreed across hosts first (_staged_async_save)."""
+        exc = self._collect_staged_errors(block)
+        if exc is not None:
+            raise exc
 
     def _gc(self, model_dir: str) -> None:
+        """Best-effort retention: _gc runs on process 0 only, directly
+        before save()'s collective (the gather agreement / the orbax
+        async save), so a raise here would diverge host 0 from its peers
+        and wedge the fleet in the collective. A transient remote-fs
+        error just defers the deletion to the next save."""
         if not self.keep_last_n:
             return
         import jax
 
         if jax.process_index() != 0:
             return
-        # Only completed checkpoints are listed, so an in-flight save can
-        # never be collected out from under its commit.
-        steps = list_checkpoint_steps(model_dir)
-        for step in steps[: -self.keep_last_n]:
-            path = checkpoint_path(model_dir, step)
-            _logger.info("retention(%d): deleting %s", self.keep_last_n, path)
-            fs_lib.rmtree(path)
+        try:
+            # Only completed checkpoints are listed, so an in-flight save
+            # can never be collected out from under its commit.
+            steps = list_checkpoint_steps(model_dir)
+            for step in steps[: -self.keep_last_n]:
+                path = checkpoint_path(model_dir, step)
+                _logger.info(
+                    "retention(%d): deleting %s", self.keep_last_n, path)
+                fs_lib.rmtree(path)
+        except Exception:
+            _logger.warning(
+                "retention GC failed for %s; will retry on the next save",
+                model_dir, exc_info=True,
+            )
 
     def wait(self) -> None:
         """Block until every started save has committed."""

@@ -51,6 +51,54 @@ def _config_hash(cfg: dict) -> str:
     ).hexdigest()[:12]
 
 
+def _code_hash() -> str:
+    """Fingerprint of the kernel + train-loop source a TPU measurement
+    depends on. A carried-forward TPU number can then never silently
+    claim currency across a kernel rewrite (round-4 verdict weak #1:
+    `last_tpu_config_matches_current` pinned only the model config while
+    every pallas call path changed underneath it)."""
+    import glob
+    import hashlib
+
+    digest = hashlib.sha256()
+    paths = sorted(
+        glob.glob(os.path.join(_REPO, "tf_yarn_tpu", "ops", "*.py"))
+        # The kernel DISPATCH (attention_impl / fused_norms wiring) lives
+        # in the model files — a rewrite there changes what a TPU number
+        # measures just as surely as a kernel edit.
+        + glob.glob(os.path.join(_REPO, "tf_yarn_tpu", "models", "*.py"))
+    )
+    paths.append(os.path.join(_REPO, "tf_yarn_tpu", "training.py"))
+    paths.append(os.path.join(_REPO, "tf_yarn_tpu", "benchmark.py"))
+    paths.append(os.path.join(_REPO, "benchmarks", "run.py"))
+    for path in paths:
+        try:
+            with open(path, "rb") as fh:
+                digest.update(os.path.basename(path).encode())
+                digest.update(fh.read())
+        except OSError:
+            digest.update(f"missing:{os.path.basename(path)}".encode())
+    return digest.hexdigest()[:12]
+
+
+def _prior_round_cpu_value():
+    """(round file, value) of the newest driver-recorded CPU-fallback
+    headline, for drift detection across rounds (round-4 verdict weak
+    #2: 521.9 -> 456.4 samples/s went unnoticed and unexplained)."""
+    import glob
+
+    found = None
+    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                parsed = json.load(fh).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        if "cpu-fallback" in str(parsed.get("unit", "")) and parsed.get("value"):
+            found = (os.path.basename(path), float(parsed["value"]))
+    return found
+
+
 def _log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
@@ -247,6 +295,15 @@ def _stale_tpu_fields() -> dict:
         "last_tpu_config_matches_current": (
             stale_hash == current_hash if stale_hash else None
         ),
+        # Pin the CODE too: a table written before the current kernel /
+        # train-loop source (or one with no recorded code hash at all)
+        # reports False — the number measured different code.
+        "last_tpu_code_hash": table.get("code_hash"),
+        "last_tpu_code_matches_current": (
+            table.get("code_hash") == _code_hash()
+            if table.get("code_hash")
+            else False
+        ),
     }
     decode = table.get("decode") or {}
     for key in ("decode_tokens_per_sec_bf16", "decode_tokens_per_sec_int8"):
@@ -339,12 +396,21 @@ def bench_flagship_train():
 
     table = []
     model_desc = None
+    # The CPU smoke number is a 5-step tiny-model run with ~±7% run-to-
+    # run noise (measured round 5); the median of 3 reps keeps the cross-
+    # round drift signal meaningful. TPU runs are long enough already.
+    reps = 1 if on_tpu else 3
     for name, overrides in variants:
         config = (TransformerConfig(**{**base, **overrides})
                   if overrides is not None else TransformerConfig.tiny())
         model_desc = f"d_model={config.d_model}, layers={config.n_layers}"
         try:
-            stats = _run_variant(config, batch_size, seq_len, steps, devices)
+            runs = sorted(
+                (_run_variant(config, batch_size, seq_len, steps, devices)
+                 for _ in range(reps)),
+                key=lambda s: s["samples_per_sec_per_chip"],
+            )
+            stats = runs[len(runs) // 2]
         except Exception as exc:  # a broken kernel must not kill the bench
             _log(f"variant {name}: FAILED: {type(exc).__name__}: {exc}")
             table.append({"variant": name, "error": f"{exc}"})
@@ -384,6 +450,20 @@ def bench_flagship_train():
         result["mfu"] = best["mfu"]
 
     if not on_tpu:
+        # Cross-round drift check on the CPU-fallback headline: the same
+        # tiny config should not silently lose throughput round over
+        # round (round-4 verdict weak #2).
+        prior = _prior_round_cpu_value()
+        if prior:
+            prior_file, prior_value = prior
+            drift_pct = round(100.0 * (result["value"] / prior_value - 1), 1)
+            result["cpu_prev_value"] = prior_value
+            result["cpu_prev_round_file"] = prior_file
+            result["cpu_drift_pct"] = drift_pct
+            if abs(drift_pct) > 5.0:
+                _log(f"WARNING: cpu-fallback drift {drift_pct:+.1f}% vs "
+                     f"{prior_file} ({prior_value}); >5% on the same config "
+                     "— investigate before trusting cross-round comparisons")
         # A wedged relay must not erase the hardware evidence: surface the
         # committed TPU measurement with provenance, clearly staleness-
         # labeled, next to the fresh CPU smoke number.
@@ -410,6 +490,7 @@ def bench_flagship_train():
         "config": {**base, "batch": batch_size, "seq": seq_len},
         "config_hash": _config_hash({**base, "batch": batch_size,
                                      "seq": seq_len}),
+        "code_hash": _code_hash(),
         "device": devices[0].device_kind,
         "git_commit": _git_head(),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),

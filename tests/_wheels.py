@@ -1,0 +1,33 @@
+"""Hand-built minimal wheels for offline packaging tests (CI has no
+egress, so `pip download` can never run here; these exercise the
+wheelhouse channel end-to-end with `pip install --no-index`)."""
+
+import os
+import zipfile
+
+
+def make_wheel(out_dir: str, name: str = "deppkg", version: str = "1.0",
+               body: str = "VALUE = 42\n") -> str:
+    """Write `<name>-<version>-py3-none-any.whl` containing a single
+    top-level module; returns the wheel path. The dist-info trio
+    (METADATA/WHEEL/RECORD) is the minimum pip requires."""
+    os.makedirs(out_dir, exist_ok=True)
+    wheel = os.path.join(out_dir, f"{name}-{version}-py3-none-any.whl")
+    info = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(wheel, "w") as zf:
+        zf.writestr(f"{name}.py", body)
+        zf.writestr(
+            f"{info}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        zf.writestr(
+            f"{info}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: tests\n"
+            "Root-Is-Purelib: true\nTag: py3-none-any\n",
+        )
+        zf.writestr(
+            f"{info}/RECORD",
+            f"{name}.py,,\n{info}/METADATA,,\n{info}/WHEEL,,\n"
+            f"{info}/RECORD,,\n",
+        )
+    return wheel

@@ -178,14 +178,66 @@ def choose_master(
     return addr
 
 
+def _install_shipped_wheels() -> None:
+    """File-channel third-party deps: a `_shipped_wheels/` dir in the
+    task workdir (packaging.ship_files with requirements=) is
+    pip-installed --no-index into `_pydeps/` and prepended to sys.path
+    (and PYTHONPATH, for nb_proc_per_worker children) before the
+    experiment unpickles — the backend-channel analog of the reference
+    always shipping the whole interpreter env (reference:
+    client.py:421-424, packaging.py:39-56)."""
+    import subprocess
+    import sys as _sys
+
+    from tf_yarn_tpu.packaging import WHEELHOUSE_MANIFEST
+
+    house = os.path.abspath("_shipped_wheels")
+    if not os.path.isdir(house):
+        return
+    target = os.path.abspath("_pydeps")
+    marker = os.path.join(target, ".tpu_yarn_done")
+    if not os.path.exists(marker):
+        subprocess.run(
+            [_sys.executable, "-m", "pip", "install", "-q", "--no-index",
+             "--find-links", house, "--target", target,
+             "-r", os.path.join(house, WHEELHOUSE_MANIFEST)],
+            check=True,
+        )
+        # pip does not create --target for an empty manifest.
+        os.makedirs(target, exist_ok=True)
+        with open(marker, "w"):
+            pass
+        _logger.info("installed shipped wheelhouse %s -> %s", house, target)
+    if target not in _sys.path:
+        _sys.path.insert(0, target)
+    os.environ["PYTHONPATH"] = (
+        target + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+
+
 def get_experiment(kv: KVStore, timeout: float = 300.0):
     """Unpickle and call the experiment closure; failures broadcast both
     `start` and `stop` so the driver can attribute them (reference:
     _task_commons.py:55-63)."""
     task = get_task()
     try:
+        _install_shipped_wheels()
         fn_bytes = kv.wait(constants.KV_EXPERIMENT_FN, timeout=timeout)
-        experiment = cloudpickle.loads(fn_bytes)()
+        try:
+            experiment = cloudpickle.loads(fn_bytes)()
+        except ModuleNotFoundError as missing:
+            # Fail fast with the dep's NAME and the remediation, not a
+            # bare unpickle traceback: the worker image simply doesn't
+            # carry this library (the reference never hits this class of
+            # failure because it ships the whole env as a pex).
+            raise ModuleNotFoundError(
+                f"experiment requires module {missing.name!r}, which is "
+                "not installed on this worker. Ship it with "
+                "run_on_tpu(requirements=[...]) (wheel channel), stage "
+                "pre-downloaded wheels via wheels_dir=, or bake it into "
+                "the TPU VM image.",
+                name=missing.name,
+            ) from missing
     except Exception as exc:
         event.start_event(kv, task)
         event.stop_event(kv, task, exc)

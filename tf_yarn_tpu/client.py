@@ -390,6 +390,8 @@ def run_on_tpu(
     pre_script_hook: str = "",
     env_staging_dir: Optional[str] = None,
     ship_code: Optional[bool] = None,
+    requirements=None,
+    wheels_dir: Optional[str] = None,
     nb_retries: int = 0,
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
@@ -415,6 +417,19 @@ def run_on_tpu(
     need only a bare interpreter + the deps baked into the TPU VM image.
     `ship_code=False` opts out (code pre-provisioned via `remote_prefix`);
     `ship_code=True` forces shipping even on a local backend.
+
+    Third-party deps absent from the TPU VM image travel too (the
+    reference pex-ships the whole interpreter env, client.py:421-424):
+    `requirements` (pip specs or a requirements.txt path) resolves
+    driver-side into a wheelhouse — staged next to the code zips, or
+    streamed over the file channel — that workers `pip install
+    --no-index` before unpickling the experiment. `wheels_dir` supplies
+    pre-downloaded wheels instead of `pip download` (air-gapped
+    drivers). Without either, a missing import fails fast on the worker
+    naming the module. A driver whose OS/CPython differs from the TPU
+    VM image should pre-resolve with
+    `packaging.build_wheelhouse(platform=..., python_version=...)` and
+    pass the result as `wheels_dir`.
     """
     task_specs = dict(task_specs) if task_specs else single_server_topology()
     check_topology(task_specs)
@@ -427,17 +442,30 @@ def run_on_tpu(
     files = dict(files or {})
     if ship_code is None:
         ship_code = getattr(backend, "is_remote", True)
+    if (requirements is not None or wheels_dir is not None) and not ship_code:
+        raise ValueError(
+            "requirements=/wheels_dir= travel with the shipped env; "
+            "they have no effect with ship_code=False"
+        )
     if ship_code:
         from tf_yarn_tpu import packaging
 
         if env_staging_dir is not None:
-            ship_hook = packaging.ship_env(env_staging_dir)
+            ship_hook = packaging.ship_env(
+                env_staging_dir, requirements=requirements,
+                wheels_dir=wheels_dir,
+                # Install wheels under the interpreter that will run the
+                # task, so pip's compatibility tags match it.
+                python=getattr(backend, "python", None) or "python3",
+            )
             pre_script_hook = (
                 f"{ship_hook} && {pre_script_hook}" if pre_script_hook
                 else ship_hook
             )
         else:
-            for ship_name, ship_src in packaging.ship_files().items():
+            ship_entries = packaging.ship_files(
+                requirements=requirements, wheels_dir=wheels_dir)
+            for ship_name, ship_src in ship_entries.items():
                 files.setdefault(ship_name, ship_src)
     serialized_fn = cloudpickle.dumps(experiment_fn)
 

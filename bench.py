@@ -386,6 +386,12 @@ def bench_flagship_train():
             ("xla+fused_norms", dict(attention_impl="xla", fused_norms=True)),
             ("xla+fused+unroll", dict(attention_impl="xla", fused_norms=True,
                                       scan_layers=False)),
+            # fused norms with the recompute backward (round-4 behavior)
+            # vs the round-5 dx kernels — the rmsnorm-bwd A/B
+            # (TPU_YARN_NORM_KERNEL_BWD env seam, docs/Performance.md).
+            ("flash+fused+unroll+bwd_recompute",
+             dict(attention_impl="flash", fused_norms=True,
+                  scan_layers=False, _norm_kernel_bwd=False)),
             ("flash+fused+unroll", dict(attention_impl="flash",
                                         fused_norms=True, scan_layers=False)),
         ]
@@ -401,9 +407,14 @@ def bench_flagship_train():
     # round drift signal meaningful. TPU runs are long enough already.
     reps = 1 if on_tpu else 3
     for name, overrides in variants:
+        overrides = dict(overrides) if overrides is not None else None
+        norm_bwd = (overrides.pop("_norm_kernel_bwd", True)
+                    if overrides is not None else True)
         config = (TransformerConfig(**{**base, **overrides})
                   if overrides is not None else TransformerConfig.tiny())
         model_desc = f"d_model={config.d_model}, layers={config.n_layers}"
+        prior_bwd = os.environ.get("TPU_YARN_NORM_KERNEL_BWD")
+        os.environ["TPU_YARN_NORM_KERNEL_BWD"] = "1" if norm_bwd else "0"
         try:
             runs = sorted(
                 (_run_variant(config, batch_size, seq_len, steps, devices)
@@ -415,6 +426,11 @@ def bench_flagship_train():
             _log(f"variant {name}: FAILED: {type(exc).__name__}: {exc}")
             table.append({"variant": name, "error": f"{exc}"})
             continue
+        finally:
+            if prior_bwd is None:
+                os.environ.pop("TPU_YARN_NORM_KERNEL_BWD", None)
+            else:
+                os.environ["TPU_YARN_NORM_KERNEL_BWD"] = prior_bwd
         row = {
             "variant": name,
             "samples_per_sec_per_chip": round(
@@ -527,7 +543,8 @@ def bench_flagship_train():
             # Fresh measurement replaces any carried-forward stale section.
             ab["long_context"] = {
                 key: longctx[key]
-                for key in ("tokens_per_sec_per_chip", "step_time_ms", "mfu")
+                for key in ("tokens_per_sec_per_chip", "step_time_ms", "mfu",
+                            "variants", "attn_microbench")
                 if key in longctx
             }
             _write_ab(ab)
@@ -537,6 +554,31 @@ def bench_flagship_train():
             _log(f"long_context: {ab['long_context']}")
         except Exception as exc:
             _log(f"long-context bench FAILED: {type(exc).__name__}: {exc}")
+        # The full model-family A/B matrices (bert fused-LN fwd/bwd,
+        # resnet stem/batch, ViT fused-LN): a wedged relay has starved
+        # every round of these (VERDICT r4 item 1) — so capture them in
+        # the SAME live-chip window as the flagship, incrementally
+        # persisted so a timeout mid-matrix keeps the earlier sections.
+        # TPU_YARN_BENCH_SKIP_FAMILIES=1 opts out for a quick run.
+        if os.environ.get("TPU_YARN_BENCH_SKIP_FAMILIES") != "1":
+            for section, bench_fn in (
+                ("bert_base", suite.bench_bert_base),
+                ("resnet50", suite.bench_resnet50),
+                ("vit_base", suite.bench_vit_base),
+            ):
+                try:
+                    stats = bench_fn(tpu=True)
+                    ab[section] = {
+                        key: stats[key]
+                        for key in ("samples_per_sec_per_chip",
+                                    "step_time_ms", "mfu", "variants")
+                        if key in stats
+                    }
+                    _write_ab(ab)
+                    _log(f"{section}: {ab[section]}")
+                except Exception as exc:
+                    _log(f"{section} bench FAILED: "
+                         f"{type(exc).__name__}: {exc}")
     return result
 
 

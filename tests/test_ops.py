@@ -22,14 +22,39 @@ def test_rmsnorm_matches_reference(shape, dtype):
     assert out.dtype == x.dtype
 
 
-def test_rmsnorm_grad_matches_reference():
+@pytest.mark.parametrize("kernel_bwd", [True, False])
+@pytest.mark.parametrize("shape", [(4, 32), (3, 7, 48), (5, 33)])
+def test_rmsnorm_grad_matches_reference(kernel_bwd, shape):
+    """Both backward paths (fused dx kernel / recompute-through-reference)
+    against jax.grad of the reference, including non-divisible rows."""
     rng = np.random.RandomState(1)
-    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
-    scale = jnp.asarray(rng.rand(32).astype(np.float32))
-    g1 = jax.grad(lambda x, s: rmsnorm(x, s).sum(), argnums=(0, 1))(x, scale)
-    g2 = jax.grad(lambda x, s: rmsnorm_reference(x, s).sum(), argnums=(0, 1))(x, scale)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    scale = jnp.asarray(rng.rand(shape[-1]).astype(np.float32))
+    # A non-trivial cotangent: .sum() alone would hide dx terms that
+    # only differ under row-varying upstream gradients.
+    w = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    g1 = jax.grad(
+        lambda x, s: (rmsnorm(x, s, kernel_bwd=kernel_bwd) * w).sum(),
+        argnums=(0, 1))(x, scale)
+    g2 = jax.grad(
+        lambda x, s: (rmsnorm_reference(x, s) * w).sum(),
+        argnums=(0, 1))(x, scale)
     for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_rmsnorm_kernel_bwd_bf16():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 64).astype(np.float32), jnp.bfloat16)
+    scale = jnp.asarray(rng.rand(64).astype(np.float32))
+    g1 = jax.grad(lambda x: rmsnorm(x, scale, kernel_bwd=True)
+                  .astype(jnp.float32).sum())(x)
+    g2 = jax.grad(lambda x: rmsnorm_reference(x, scale)
+                  .astype(jnp.float32).sum())(x)
+    assert g1.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(g1, np.float32), np.asarray(g2, np.float32), atol=5e-2)
 
 
 @pytest.mark.parametrize("shape,groups", [
@@ -138,21 +163,88 @@ def test_layernorm_matches_reference_and_flax(shape, dtype):
     )
 
 
-def test_layernorm_grad_matches_reference():
+@pytest.mark.parametrize("kernel_bwd", [True, False])
+@pytest.mark.parametrize("shape", [(4, 32), (3, 7, 48), (5, 33)])
+def test_layernorm_grad_matches_reference(kernel_bwd, shape):
     from tf_yarn_tpu.ops.layernorm import layernorm, layernorm_reference
 
     rng = np.random.RandomState(1)
-    x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    scale = jnp.asarray(rng.rand(shape[-1]).astype(np.float32))
+    bias = jnp.asarray(rng.randn(shape[-1]).astype(np.float32) * 0.1)
+    w = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    g1 = jax.grad(
+        lambda x, s, b: (layernorm(x, s, b, kernel_bwd=kernel_bwd) * w).sum(),
+        argnums=(0, 1, 2)
+    )(x, scale, bias)
+    g2 = jax.grad(
+        lambda x, s, b: (layernorm_reference(x, s, b) * w).sum(),
+        argnums=(0, 1, 2)
+    )(x, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_norm_kernel_bwd_partitions_under_pjit():
+    """The fused dx kernels shard by rows under pjit like the forward
+    (same rowwise rule, with the cotangent as a second row operand), and
+    dscale/dbias cross-shard sums match the reference."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tf_yarn_tpu.ops.layernorm import layernorm, layernorm_reference
+    from tf_yarn_tpu.ops.rmsnorm import rmsnorm, rmsnorm_reference
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    devices = select_devices(8, platform="cpu")
+    mesh = Mesh(np.array(devices).reshape(4, 2), ("dp", "tp"))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 16, 32).astype(np.float32))
     scale = jnp.asarray(rng.rand(32).astype(np.float32))
     bias = jnp.asarray(rng.randn(32).astype(np.float32) * 0.1)
-    g1 = jax.grad(
-        lambda x, s, b: layernorm(x, s, b).sum(), argnums=(0, 1, 2)
-    )(x, scale, bias)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", "tp", None)))
+    ss = jax.device_put(scale, NamedSharding(mesh, P(None)))
+    bs = jax.device_put(bias, NamedSharding(mesh, P(None)))
+
+    g1 = jax.jit(jax.grad(
+        lambda x, s: rmsnorm(x, s, kernel_bwd=True).sum(), argnums=(0, 1)
+    ))(xs, ss)
+    g2 = jax.grad(
+        lambda x, s: rmsnorm_reference(x, s).sum(), argnums=(0, 1)
+    )(x, scale)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+    # dx keeps the row sharding.
+    assert g1[0].sharding.spec[0] == "dp", g1[0].sharding
+
+    g1 = jax.jit(jax.grad(
+        lambda x, s, b: layernorm(x, s, b, kernel_bwd=True).sum(),
+        argnums=(0, 1, 2)
+    ))(xs, ss, bs)
     g2 = jax.grad(
         lambda x, s, b: layernorm_reference(x, s, b).sum(), argnums=(0, 1, 2)
     )(x, scale, bias)
     for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_norm_kernel_bwd_empty_batch():
+    from tf_yarn_tpu.ops.layernorm import layernorm
+    from tf_yarn_tpu.ops.rmsnorm import rmsnorm
+
+    scale = jnp.ones((16,))
+    bias = jnp.zeros((16,))
+    gx, gs = jax.grad(
+        lambda x, s: rmsnorm(x, s, kernel_bwd=True).sum(), argnums=(0, 1)
+    )(jnp.zeros((0, 16)), scale)
+    assert gx.shape == (0, 16) and gs.shape == (16,)
+    gx, gs, gb = jax.grad(
+        lambda x, s, b: layernorm(x, s, b, kernel_bwd=True).sum(),
+        argnums=(0, 1, 2)
+    )(jnp.zeros((0, 16)), scale, bias)
+    assert gx.shape == (0, 16) and gs.shape == (16,) and gb.shape == (16,)
 
 
 def test_rowwise_norms_partition_under_pjit():

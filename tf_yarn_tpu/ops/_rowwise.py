@@ -23,11 +23,25 @@ def default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
-    """Run `kernel(x_block, *vector_refs, o_ref)` over row blocks of x.
+def default_kernel_bwd() -> bool:
+    """Fused dx backward kernels on by default; TPU_YARN_NORM_KERNEL_BWD=0
+    reverts to the recompute-through-reference vjp (the A/B knob — an env
+    seam instead of a config field so duck-typed model configs need no
+    new field; read at trace time, so benchmarks toggling it re-jit)."""
+    import os
 
-    x: [..., d]; vectors: [d]-shaped operands shared by every block.
-    Returns an array of x's shape and dtype.
+    return os.environ.get("TPU_YARN_NORM_KERNEL_BWD", "1") != "0"
+
+
+def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool,
+                 row_operands=()):
+    """Run `kernel(x_block, *row_blocks, *vector_refs, o_ref)` over row
+    blocks of x.
+
+    x: [..., d]; row_operands: extra arrays of x's shape blocked the same
+    way (a backward pass's cotangent rides here); vectors: [d]-shaped
+    operands shared by every block. Returns an array of x's shape and
+    dtype.
     """
     orig_shape = x.shape
     d = orig_shape[-1]
@@ -37,20 +51,22 @@ def rowwise_call(kernel, x, vectors, block_rows: int, interpret: bool):
     if rows == 0:
         return x  # empty batch: nothing to normalize (0 % 0 would raise)
     x2 = x.reshape(rows, d)
+    extra = [r.reshape(rows, d) for r in row_operands]
     block_rows = min(block_rows, rows)
     if rows % block_rows:
         # Largest divisor <= block_rows keeps the grid small for
         # almost-divisible shapes (vs collapsing straight to 1 row/step).
         block_rows = math.gcd(rows, block_rows)
+    row_spec = pl.BlockSpec((block_rows, d), lambda i: (i, 0))
     out = pl.pallas_call(
         kernel,
         grid=(rows // block_rows,),
-        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0))]
+        in_specs=[row_spec] * (1 + len(extra))
         + [pl.BlockSpec((d,), lambda i: (0,)) for _ in vectors],
-        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_specs=row_spec,
         out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
         interpret=interpret,
-    )(x2, *vectors)
+    )(x2, *extra, *vectors)
     return out.reshape(orig_shape)
 
 
@@ -99,21 +115,23 @@ def padded_spec(shape, sharding) -> list:
     return list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
 
 
-def sharded_rowwise(local_fn, n_vectors: int):
+def sharded_rowwise(local_fn, n_vectors: int, n_rows: int = 1):
     """Partition-aware row-wise op: rows shard freely, the feature
-    (last) dim and the [d] parameter vectors must be replicated."""
+    (last) dim and the [d] parameter vectors must be replicated.
+    `n_rows` > 1 admits extra x-shaped operands (a backward pass's
+    cotangent) sharded identically to x."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     def make_shardings(mesh, arg_shapes, result_shape):
         spec = padded_spec(arg_shapes[0].shape, arg_shapes[0].sharding)
         x_sh = NamedSharding(mesh, PartitionSpec(*spec[:-1], None))
         vec_sh = NamedSharding(mesh, PartitionSpec(None))
-        return (x_sh,) + (vec_sh,) * n_vectors, x_sh
+        return (x_sh,) * n_rows + (vec_sh,) * n_vectors, x_sh
 
-    vec_rule = ", ".join(["d"] * n_vectors)
+    operand_rule = ", ".join(["... d"] * n_rows + ["d"] * n_vectors)
     return make_sharded_op(
         local_fn,
-        rule=f"... d, {vec_rule} -> ... d",
+        rule=f"{operand_rule} -> ... d",
         need_replication=("d",),
         make_shardings=make_shardings,
     )
@@ -150,14 +168,17 @@ def sharded_batch_only(local_fn, rule: str, need_replication: tuple):
 
 @functools.lru_cache(maxsize=None)
 def sharded_rowwise_call(kernel_factory, kernel_args, n_vectors: int,
-                         block_rows: int, interpret: bool):
+                         block_rows: int, interpret: bool,
+                         n_rows: int = 1):
     """Cached partition-aware rowwise op. `kernel_factory(*kernel_args)`
     builds the pallas kernel body; all keys must be hashable (floats,
     ints, bools), so each distinct config creates exactly one
     custom_partitioning primitive for the process lifetime."""
     kernel = kernel_factory(*kernel_args)
 
-    def local_fn(x, *vectors):
-        return rowwise_call(kernel, x, vectors, block_rows, interpret)
+    def local_fn(x, *rest):
+        extra, vectors = rest[: n_rows - 1], rest[n_rows - 1:]
+        return rowwise_call(kernel, x, vectors, block_rows, interpret,
+                            row_operands=extra)
 
-    return sharded_rowwise(local_fn, n_vectors)
+    return sharded_rowwise(local_fn, n_vectors, n_rows=n_rows)

@@ -6,8 +6,13 @@ ops/rmsnorm.py with the extra mean subtraction and bias; variance is
 computed two-pass on the in-VMEM block (mean first, then centered
 squares), so there is no E[x²]−mean² cancellation to clamp.
 
-Backward recomputes via the XLA reference (the rematerialization trade
-shared by ops/rmsnorm.py and ops/groupnorm.py).
+Backward (kernel_bwd=True, default): dx in one fused pass via the hand
+vjp ``dx = r·(gs − mean(gs) − norm·mean(gs·norm))`` with all three
+rowwise reductions in VMEM; dscale/dbias are cross-row XLA reductions
+(see ops/rmsnorm.py for the sharding reasoning). kernel_bwd=False keeps
+the recompute-through-reference vjp — the A/B knob; ops/groupnorm.py
+stays recompute-only (its reduction spans spatial dims, outside the
+_rowwise scaffolding).
 """
 
 from __future__ import annotations
@@ -54,21 +59,57 @@ def _layernorm_forward(x, scale, bias, eps, block_rows, interpret):
     )(x, scale, bias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _layernorm(x, scale, bias, eps, block_rows, interpret):
+def _layernorm_bwd_dx_kernel(x_ref, g_ref, scale_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    gs = g * scale_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    centered = x - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + eps)
+    norm = centered * r
+    dx = r * (gs
+              - jnp.mean(gs, axis=-1, keepdims=True)
+              - norm * jnp.mean(gs * norm, axis=-1, keepdims=True))
+    o_ref[...] = dx.astype(o_ref.dtype)
+
+
+def _make_layernorm_bwd_dx_kernel(eps: float):
+    return functools.partial(_layernorm_bwd_dx_kernel, eps=eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _layernorm(x, scale, bias, eps, block_rows, interpret, kernel_bwd):
     return _layernorm_forward(x, scale, bias, eps, block_rows, interpret)
 
 
-def _layernorm_fwd(x, scale, bias, eps, block_rows, interpret):
+def _layernorm_fwd(x, scale, bias, eps, block_rows, interpret, kernel_bwd):
     return (_layernorm_forward(x, scale, bias, eps, block_rows, interpret),
             (x, scale, bias))
 
 
-def _layernorm_bwd(eps, block_rows, interpret, residuals, g):
+def _layernorm_bwd(eps, block_rows, interpret, kernel_bwd, residuals, g):
     x, scale, bias = residuals
-    _, vjp = jax.vjp(
-        lambda x, s, b: layernorm_reference(x, s, b, eps), x, scale, bias)
-    return vjp(g)
+    if not kernel_bwd:
+        _, vjp = jax.vjp(
+            lambda x, s, b: layernorm_reference(x, s, b, eps), x, scale, bias)
+        return vjp(g)
+    from tf_yarn_tpu.ops._rowwise import sharded_rowwise_call
+
+    dx = sharded_rowwise_call(
+        _make_layernorm_bwd_dx_kernel, (eps,), 1, block_rows, interpret,
+        n_rows=2,
+    )(x, g, scale)
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    centered = x32 - mean
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    norm = centered * jax.lax.rsqrt(var + eps)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(g32 * norm, axis=reduce_axes).astype(scale.dtype)
+    dbias = jnp.sum(g32, axis=reduce_axes).astype(bias.dtype)
+    return dx, dscale, dbias
 
 
 _layernorm.defvjp(_layernorm_fwd, _layernorm_bwd)
@@ -81,10 +122,15 @@ def layernorm(
     eps: float = 1e-12,
     block_rows: int = 256,
     interpret: Optional[bool] = None,
+    kernel_bwd: Optional[bool] = None,
 ) -> jax.Array:
-    """Fused LayerNorm over the last dim; differentiable."""
-    if interpret is None:
-        from tf_yarn_tpu.ops._rowwise import default_interpret
+    """Fused LayerNorm over the last dim; differentiable. `kernel_bwd`
+    selects the fused dx kernel (default; env TPU_YARN_NORM_KERNEL_BWD=0
+    flips it) vs recompute-through-reference backward — the A/B knob."""
+    from tf_yarn_tpu.ops._rowwise import default_interpret, default_kernel_bwd
 
+    if interpret is None:
         interpret = default_interpret()
-    return _layernorm(x, scale, bias, eps, block_rows, interpret)
+    if kernel_bwd is None:
+        kernel_bwd = default_kernel_bwd()
+    return _layernorm(x, scale, bias, eps, block_rows, interpret, kernel_bwd)

@@ -1,9 +1,19 @@
-"""Fused RMSNorm — pallas TPU kernel.
+"""Fused RMSNorm — pallas TPU kernel, forward and backward.
 
-One VMEM round-trip per row block instead of the separate square/mean/
-rsqrt/mul HLOs: x is read once, reduced and scaled in f32 on the VPU, and
-written once in the storage dtype. Backward recomputes via the XLA
-reference (same rematerialization trade as ops/flash_attention.py).
+Forward: one VMEM round-trip per row block instead of the separate
+square/mean/rsqrt/mul HLOs: x is read once, reduced and scaled in f32 on
+the VPU, and written once in the storage dtype.
+
+Backward (kernel_bwd=True, default): dx in one fused pass — the hand
+vjp ``dx = r·(g·s) − x·r³·mean(g·s·x)`` keeps both rowwise reductions
+in VMEM, reading x and g once and writing dx once. dx is row-local
+given the replicated scale, so it shards under the SAME rowwise rule as
+the forward. dscale = Σ_rows g·x·r is a cross-row (and under pjit
+cross-shard) reduction, left to an XLA fusion — jnp.sum over the
+sharded rows inserts the psum, which a custom_partitioning kernel
+cannot (no axis context in its lower_fn). kernel_bwd=False keeps the
+recompute-through-reference vjp for A/B (docs/Performance.md derives
+the expected gap).
 """
 
 from __future__ import annotations
@@ -42,19 +52,45 @@ def _rmsnorm_forward(x, scale, eps: float, block_rows: int, interpret: bool):
     )(x, scale)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _rmsnorm(x, scale, eps, block_rows, interpret):
+def _rmsnorm_bwd_dx_kernel(x_ref, g_ref, scale_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    gs = g * scale_ref[...].astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    proj = jnp.mean(gs * x, axis=-1, keepdims=True)
+    o_ref[...] = (r * gs - x * (r * r * r) * proj).astype(o_ref.dtype)
+
+
+def _make_rmsnorm_bwd_dx_kernel(eps: float):
+    return functools.partial(_rmsnorm_bwd_dx_kernel, eps=eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _rmsnorm(x, scale, eps, block_rows, interpret, kernel_bwd):
     return _rmsnorm_forward(x, scale, eps, block_rows, interpret)
 
 
-def _rmsnorm_fwd(x, scale, eps, block_rows, interpret):
+def _rmsnorm_fwd(x, scale, eps, block_rows, interpret, kernel_bwd):
     return _rmsnorm_forward(x, scale, eps, block_rows, interpret), (x, scale)
 
 
-def _rmsnorm_bwd(eps, block_rows, interpret, residuals, g):
+def _rmsnorm_bwd(eps, block_rows, interpret, kernel_bwd, residuals, g):
     x, scale = residuals
-    _, vjp = jax.vjp(lambda x, s: rmsnorm_reference(x, s, eps), x, scale)
-    return vjp(g)
+    if not kernel_bwd:
+        _, vjp = jax.vjp(lambda x, s: rmsnorm_reference(x, s, eps), x, scale)
+        return vjp(g)
+    from tf_yarn_tpu.ops._rowwise import sharded_rowwise_call
+
+    dx = sharded_rowwise_call(
+        _make_rmsnorm_bwd_dx_kernel, (eps,), 1, block_rows, interpret,
+        n_rows=2,
+    )(x, g, scale)
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    reduce_axes = tuple(range(x.ndim - 1))
+    dscale = jnp.sum(g32 * x32 * r, axis=reduce_axes).astype(scale.dtype)
+    return dx, dscale
 
 
 _rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
@@ -66,10 +102,15 @@ def rmsnorm(
     eps: float = 1e-5,
     block_rows: int = 256,
     interpret: Optional[bool] = None,
+    kernel_bwd: Optional[bool] = None,
 ) -> jax.Array:
-    """Fused RMSNorm over the last dim; differentiable."""
-    if interpret is None:
-        from tf_yarn_tpu.ops._rowwise import default_interpret
+    """Fused RMSNorm over the last dim; differentiable. `kernel_bwd`
+    selects the fused dx kernel (default; env TPU_YARN_NORM_KERNEL_BWD=0
+    flips it) vs recompute-through-reference backward — the A/B knob."""
+    from tf_yarn_tpu.ops._rowwise import default_interpret, default_kernel_bwd
 
+    if interpret is None:
         interpret = default_interpret()
-    return _rmsnorm(x, scale, eps, block_rows, interpret)
+    if kernel_bwd is None:
+        kernel_bwd = default_kernel_bwd()
+    return _rmsnorm(x, scale, eps, block_rows, interpret, kernel_bwd)

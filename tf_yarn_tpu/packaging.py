@@ -240,8 +240,14 @@ _WHEELHOUSE_CACHE: Dict[tuple, str] = {}
 
 
 def _dist_name(filename: str) -> str:
-    """'mylib-1.0-py3-none-any.whl' / 'mylib-1.0.tar.gz' -> 'mylib'."""
-    return filename.split("-", 1)[0]
+    """'mylib-1.0-py3-none-any.whl' / 'python-dateutil-2.9.0.tar.gz' ->
+    'mylib' / 'python-dateutil'. Split before the first -<digit> segment:
+    wheel names escape hyphens to underscores, but pre-PEP-625 sdists
+    keep them in the project name."""
+    import re
+
+    match = re.match(r"^(.+?)-\d", filename)
+    return match.group(1) if match else filename.split("-", 1)[0]
 
 
 def _wheelhouse_cache_key(requirements, wheels_dir, platform,
@@ -255,7 +261,8 @@ def _wheelhouse_cache_key(requirements, wheels_dir, platform,
     listing = None
     if wheels_dir is not None:
         listing = tuple(
-            (name, os.path.getsize(os.path.join(wheels_dir, name)))
+            (name, os.path.getsize(os.path.join(wheels_dir, name)),
+             os.path.getmtime(os.path.join(wheels_dir, name)))
             for name in sorted(os.listdir(wheels_dir))
             if name.endswith(_DIST_SUFFIXES)
         )

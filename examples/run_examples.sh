@@ -16,6 +16,7 @@ for example in \
     llama_lora_example \
     pytorch_example \
     evaluator_sidecar_example \
+    ship_requirements_example \
     generate_example
 do
     echo "=== $example ==="

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import shutil
 import sys
 import tempfile
 
@@ -95,6 +96,66 @@ def check_env_shipping() -> bool:
         return False
 
 
+def check_wheel_shipping() -> bool:
+    """Round-trip the third-party-dep channel (run_on_tpu requirements=):
+    hand-build a wheel, resolve it through build_wheelhouse (wheels_dir
+    path — no egress needed), and pip install --no-index --target it the
+    way a worker does; the import must come from the installed copy."""
+    import os
+    import subprocess
+    import zipfile
+
+    from tf_yarn_tpu import packaging
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="check-wheel-ship-") as tmp:
+            name, version = "tpuyarnprobe", "0.0"
+            info = f"{name}-{version}.dist-info"
+            dl = os.path.join(tmp, "dl")
+            os.makedirs(dl)
+            with zipfile.ZipFile(
+                os.path.join(dl, f"{name}-{version}-py3-none-any.whl"), "w"
+            ) as zf:
+                zf.writestr(f"{name}.py", "PROBE = 'ok'\n")
+                zf.writestr(f"{info}/METADATA",
+                            f"Metadata-Version: 2.1\nName: {name}\n"
+                            f"Version: {version}\n")
+                zf.writestr(f"{info}/WHEEL",
+                            "Wheel-Version: 1.0\nGenerator: doctor\n"
+                            "Root-Is-Purelib: true\nTag: py3-none-any\n")
+                zf.writestr(f"{info}/RECORD", "")
+            house = packaging.build_wheelhouse(
+                requirements=[name], wheels_dir=dl)
+            try:
+                target = os.path.join(tmp, "pydeps")
+                install = subprocess.run(
+                    [sys.executable, "-m", "pip", "install", "-q",
+                     "--no-index", "--find-links", house, "--target", target,
+                     "-r", os.path.join(house, packaging.WHEELHOUSE_MANIFEST)],
+                    capture_output=True, text=True, timeout=120,
+                )
+                assert install.returncode == 0, (
+                    f"pip install failed: {install.stderr.strip()[-300:]}")
+                result = subprocess.run(
+                    [sys.executable, "-c",
+                     f"import {name}; print({name}.PROBE)"],
+                    capture_output=True, text=True, timeout=60,
+                    env={**os.environ, "PYTHONPATH": target},
+                )
+                assert result.returncode == 0, result.stderr.strip()[-300:]
+                assert result.stdout.strip() == "ok", result.stdout
+            finally:
+                # build_wheelhouse memoizes per process for drivers; a
+                # short-lived CLI must not leak the /tmp house.
+                shutil.rmtree(os.path.dirname(house), ignore_errors=True)
+        print("OK   wheel shipping (wheelhouse -> pip install --no-index "
+              "-> import)")
+        return True
+    except Exception as exc:
+        print(f"FAIL wheel shipping: {exc}")
+        return False
+
+
 def check_local_run() -> bool:
     """Launch a real one-task run through the full driver path (the analog
     of the reference's remote 1-container check, check_hadoop_env.py:56-93)."""
@@ -144,7 +205,8 @@ def main() -> int:
     )
     args = parser.parse_args()
     logging.basicConfig(level=logging.WARNING)
-    ok = check_jax() & check_coordination() & check_env_shipping()
+    ok = (check_jax() & check_coordination() & check_env_shipping()
+          & check_wheel_shipping())
     if not args.skip_run:
         ok &= check_local_run()
     print("all checks passed" if ok else "some checks FAILED")

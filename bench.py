@@ -453,7 +453,7 @@ def bench_flagship_train():
             + "; ".join(str(r.get("error", ""))[:80] for r in table) + ")",
         }
         result.update(_stale_tpu_fields())
-        return result
+        return result, None
     best = max(ok_rows, key=lambda r: r["samples_per_sec_per_chip"])
 
     result = {
@@ -489,7 +489,7 @@ def bench_flagship_train():
                  f"({stale.get('last_tpu_device')}, commit "
                  f"{stale.get('last_tpu_commit')}, {stale.get('last_tpu_date')})")
             result.update(stale)
-        return result
+        return result, None
 
     # --- TPU: persist the A/B table incrementally (flagship first, so a
     # timeout mid-extras still leaves it recorded), then fold in decode
@@ -517,7 +517,11 @@ def bench_flagship_train():
         if previous.get(section):
             ab[section] = {
                 **previous[section],
-                "stale_from_commit": previous.get("git_commit")
+                # Keep the ORIGINAL measurement commit across repeated
+                # carry-forwards — previous.git_commit is only right the
+                # first time the section goes stale.
+                "stale_from_commit": previous[section].get("stale_from_commit")
+                or previous.get("git_commit")
                 or _ab_file_provenance()["git_commit"],
             }
     _write_ab(ab)
@@ -555,15 +559,10 @@ def bench_flagship_train():
             _log(f"long_context: {ab['long_context']}")
         except Exception as exc:
             _log(f"long-context bench FAILED: {type(exc).__name__}: {exc}")
-        # The full model-family A/B matrices run AFTER the headline JSON
-        # line prints (main) — a driver timeout mid-matrix must never
-        # cost the round its headline record.
-        global _PENDING_FAMILY_BLITZ
-        _PENDING_FAMILY_BLITZ = (suite, ab)
-    return result
-
-
-_PENDING_FAMILY_BLITZ = None
+    # The full model-family A/B matrices run AFTER the headline JSON
+    # line prints (main) — a driver timeout mid-matrix must never cost
+    # the round its headline record.
+    return result, (suite, ab)
 
 
 def _run_family_blitz(suite, ab) -> None:
@@ -592,7 +591,7 @@ def _run_family_blitz(suite, ab) -> None:
 
 
 def main() -> None:
-    result = bench_flagship_train()
+    result, pending_blitz = bench_flagship_train()
     baseline_path = os.path.join(_REPO, "BENCH_BASELINE.json")
     vs_baseline = 1.0
     if os.path.exists(baseline_path):
@@ -609,9 +608,9 @@ def main() -> None:
     # Post-headline capture: the family matrices only ever ADD to
     # BENCH_AB.json; the one-line stdout contract above is already met,
     # and nothing here may turn the exit status red.
-    if _PENDING_FAMILY_BLITZ is not None:
+    if pending_blitz is not None:
         try:
-            _run_family_blitz(*_PENDING_FAMILY_BLITZ)
+            _run_family_blitz(*pending_blitz)
         except Exception as exc:
             _log(f"family blitz FAILED: {type(exc).__name__}: {exc}")
 

@@ -230,6 +230,60 @@ def test_norm_kernel_bwd_partitions_under_pjit():
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("kernel_bwd", [True, False])
+@pytest.mark.parametrize("shape,groups", [
+    ((2, 8, 8, 32), 4),   # NHWC, the resnet case
+    ((3, 16), 4),         # [B, C] degenerate spatial
+    ((2, 4, 4, 6), 3),    # C/G = 2
+])
+def test_groupnorm_grad_matches_reference(kernel_bwd, shape, groups):
+    from tf_yarn_tpu.ops.groupnorm import groupnorm, groupnorm_reference
+
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    scale = jnp.asarray(rng.rand(shape[-1]).astype(np.float32))
+    bias = jnp.asarray(rng.randn(shape[-1]).astype(np.float32) * 0.1)
+    w = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    g1 = jax.grad(
+        lambda x, s, b: (groupnorm(
+            x, s, b, groups, eps=1e-5, kernel_bwd=kernel_bwd) * w).sum(),
+        argnums=(0, 1, 2))(x, scale, bias)
+    g2 = jax.grad(
+        lambda x, s, b: (groupnorm_reference(
+            x, s, b, groups, eps=1e-5) * w).sum(),
+        argnums=(0, 1, 2))(x, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_groupnorm_kernel_bwd_partitions_under_pjit():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tf_yarn_tpu.ops.groupnorm import groupnorm, groupnorm_reference
+    from tf_yarn_tpu.parallel.mesh import select_devices
+
+    devices = select_devices(8, platform="cpu")
+    mesh = Mesh(np.array(devices).reshape(4, 2), ("dp", "tp"))
+    rng = np.random.RandomState(5)
+    img = jnp.asarray(rng.randn(8, 4, 4, 16).astype(np.float32))
+    scale = jnp.asarray(rng.rand(16).astype(np.float32))
+    bias = jnp.asarray(rng.randn(16).astype(np.float32) * 0.1)
+    img_s = jax.device_put(img, NamedSharding(mesh, P("dp")))
+    ss = jax.device_put(scale, NamedSharding(mesh, P(None)))
+    bs = jax.device_put(bias, NamedSharding(mesh, P(None)))
+    g1 = jax.jit(jax.grad(
+        lambda x, s, b: groupnorm(x, s, b, 4, kernel_bwd=True).sum(),
+        argnums=(0, 1, 2)))(img_s, ss, bs)
+    g2 = jax.grad(
+        lambda x, s, b: groupnorm_reference(x, s, b, 4).sum(),
+        argnums=(0, 1, 2))(img, scale, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+    assert g1[0].sharding.spec[0] == "dp", g1[0].sharding
+
+
 def test_norm_kernel_bwd_empty_batch():
     from tf_yarn_tpu.ops.layernorm import layernorm
     from tf_yarn_tpu.ops.rmsnorm import rmsnorm

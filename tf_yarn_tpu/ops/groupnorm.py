@@ -15,8 +15,14 @@ per-channel sums are folded into per-group sums with a [C, G] one-hot
 assignment matmul, and group stats broadcast back with its transpose —
 the MXU does the bookkeeping and the lane dim stays C.
 
-Backward recomputes through the XLA reference (same rematerialization
-trade as ops/rmsnorm.py and ops/flash_attention.py).
+Backward (kernel_bwd=True, default): dx in one fused pass — per
+(batch, group) the vjp is the layernorm formula
+``dx = inv·(gs − mean_g(gs) − norm·mean_g(gs·norm))``, computed on the
+same [HW, C] slab blocking with the same assignment-matmul group
+bookkeeping; dscale/dbias are cross-batch XLA reductions (see
+ops/rmsnorm.py for why they cannot live in the kernel under pjit).
+kernel_bwd=False / TPU_YARN_NORM_KERNEL_BWD=0 keeps the
+recompute-through-reference vjp — the A/B knob.
 """
 
 from __future__ import annotations
@@ -29,19 +35,25 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
+def _norm32(x, groups: int, eps: float):
+    """f32 normalized activation (no scale/bias), x's shape — THE
+    per-(batch, group) stats definition, shared by the reference and the
+    kernel-backward's dscale path so a variance/eps fix lands once."""
+    b, c = x.shape[0], x.shape[-1]
+    xg = x.astype(jnp.float32).reshape(b, -1, groups, c // groups)
+    mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
+    var = jnp.mean((xg - mean) ** 2, axis=(1, 3), keepdims=True)
+    return ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+
+
 def groupnorm_reference(x, scale, bias, groups: int, eps: float = 1e-5):
     """[..., H, W, C] (or any [..., C]) GroupNorm matching flax
     nn.GroupNorm semantics: stats over all non-batch dims within each
     channel group."""
-    b, c = x.shape[0], x.shape[-1]
-    if c % groups:
+    if x.shape[-1] % groups:
         raise ValueError(
-            f"channels ({c}) must divide into groups ({groups})")
-    x32 = x.astype(jnp.float32)
-    xg = x32.reshape(b, -1, groups, c // groups)
-    mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
-    var = jnp.mean((xg - mean) ** 2, axis=(1, 3), keepdims=True)
-    norm = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+            f"channels ({x.shape[-1]}) must divide into groups ({groups})")
+    norm = _norm32(x, groups, eps)
     return (norm * scale.astype(jnp.float32)
             + bias.astype(jnp.float32)).astype(x.dtype)
 
@@ -53,9 +65,7 @@ def _groupnorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *,
     cg = c // groups
     x2d = x.reshape(hw, c)
     # One-hot channel->group assignment, built from iota (no gathers).
-    chan = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
-    grp = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
-    assign = (chan // cg == grp).astype(jnp.float32)  # [C, G]
+    assign = _group_assign(c, groups)  # [C, G]
     # Per-channel sums -> per-group stats via the assignment matmul.
     sum_c = jnp.sum(x2d, axis=0)          # [C]
     sumsq_c = jnp.sum(x2d * x2d, axis=0)  # [C]
@@ -123,23 +133,102 @@ def _groupnorm_forward(x, scale, bias, groups, eps, interpret):
     return _sharded_groupnorm(x.ndim, groups, eps, interpret)(x, scale, bias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _groupnorm(x, scale, bias, groups, eps, interpret):
+def _group_assign(c: int, groups: int):
+    """[C, G] one-hot channel->group assignment (iota, no gathers)."""
+    cg = c // groups
+    chan = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
+    grp = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
+    return (chan // cg == grp).astype(jnp.float32)
+
+
+def _groupnorm_bwd_dx_kernel(x_ref, g_ref, scale_ref, o_ref, *,
+                             groups: int, eps: float):
+    x = x_ref[...].astype(jnp.float32)  # [1, HW, C]: one batch element
+    hw, c = x.shape[-2], x.shape[-1]
+    x2d = x.reshape(hw, c)
+    g2d = g_ref[...].astype(jnp.float32).reshape(hw, c)
+    gs = g2d * scale_ref[...].astype(jnp.float32)[None, :]
+    assign = _group_assign(c, groups)
+    n = jnp.float32(hw * (c // groups))
+    mean_g = (jnp.sum(x2d, axis=0) @ assign) / n
+    var_g = jnp.maximum(
+        (jnp.sum(x2d * x2d, axis=0) @ assign) / n - mean_g * mean_g, 0.0)
+    inv_g = jax.lax.rsqrt(var_g + eps)
+    mean_c = mean_g @ assign.T
+    inv_c = inv_g @ assign.T
+    norm = (x2d - mean_c[None, :]) * inv_c[None, :]
+    m1_c = ((jnp.sum(gs, axis=0) @ assign) / n) @ assign.T
+    m2_c = ((jnp.sum(gs * norm, axis=0) @ assign) / n) @ assign.T
+    dx = inv_c[None, :] * (gs - m1_c[None, :] - norm * m2_c[None, :])
+    o_ref[...] = dx.reshape(x.shape).astype(o_ref.dtype)
+
+
+def _groupnorm_bwd_dx_local(x, g, scale, groups, eps, interpret):
+    """Per-shard pallas call over [B_local, HW, C] slabs of x AND g."""
+    b, c = x.shape[0], x.shape[-1]
+    hw = 1
+    for dim in x.shape[1:-1]:
+        hw *= dim
+    if b == 0:
+        return x
+    slab = pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0))
+    out = pl.pallas_call(
+        functools.partial(_groupnorm_bwd_dx_kernel, groups=groups, eps=eps),
+        grid=(b,),
+        in_specs=[slab, slab, pl.BlockSpec((c,), lambda i: (0,))],
+        out_specs=slab,
+        out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+        interpret=interpret,
+    )(x.reshape(b, hw, c), g.reshape(b, hw, c), scale)
+    return out.reshape(x.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_groupnorm_bwd_dx(ndim: int, groups: int, eps: float,
+                              interpret: bool):
+    """Partition-aware dx: batch shards (each shard differentiates its
+    own images), spatial + channel replicated — same policy as forward,
+    with the cotangent as a second batch-led operand."""
+    from tf_yarn_tpu.ops._rowwise import sharded_batch_only
+
+    def local_fn(x, g, scale):
+        return _groupnorm_bwd_dx_local(x, g, scale, groups, eps, interpret)
+
+    dims = " ".join(f"s{i}" for i in range(ndim - 2))
+    return sharded_batch_only(
+        local_fn,
+        rule=f"b {dims} c, b {dims} c, c -> b {dims} c",
+        need_replication=tuple(f"s{i}" for i in range(ndim - 2)) + ("c",),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _groupnorm(x, scale, bias, groups, eps, interpret, kernel_bwd):
     return _groupnorm_forward(x, scale, bias, groups, eps, interpret)
 
 
-def _groupnorm_fwd(x, scale, bias, groups, eps, interpret):
+def _groupnorm_fwd(x, scale, bias, groups, eps, interpret, kernel_bwd):
     return (_groupnorm_forward(x, scale, bias, groups, eps, interpret),
             (x, scale, bias))
 
 
-def _groupnorm_bwd(groups, eps, interpret, residuals, g):
+def _groupnorm_bwd(groups, eps, interpret, kernel_bwd, residuals, g):
     x, scale, bias = residuals
-    _, vjp = jax.vjp(
-        lambda x, s, b: groupnorm_reference(x, s, b, groups, eps),
-        x, scale, bias,
-    )
-    return vjp(g)
+    if not kernel_bwd:
+        _, vjp = jax.vjp(
+            lambda x, s, b: groupnorm_reference(x, s, b, groups, eps),
+            x, scale, bias,
+        )
+        return vjp(g)
+    dx = _sharded_groupnorm_bwd_dx(x.ndim, groups, eps, interpret)(
+        x, g, scale)
+    # dscale/dbias: cross-batch sums, XLA-fused (auto-psum under pjit).
+    b, c = x.shape[0], x.shape[-1]
+    norm = _norm32(x, groups, eps).reshape(b, -1, c)
+    g32 = g.astype(jnp.float32).reshape(b, -1, c)
+    dscale = jnp.sum(g32 * norm, axis=(0, 1)).astype(scale.dtype)
+    dbias = jnp.sum(g32, axis=(0, 1)).astype(bias.dtype)
+    return dx, dscale, dbias
 
 
 _groupnorm.defvjp(_groupnorm_fwd, _groupnorm_bwd)
@@ -156,10 +245,15 @@ def groupnorm(
     groups: int,
     eps: float = 1e-5,
     interpret: Optional[bool] = None,
+    kernel_bwd: Optional[bool] = None,
 ) -> jax.Array:
     """Fused GroupNorm over the channel (last) dim; differentiable.
     Falls back to the XLA reference when a batch element's slab would
-    not fit VMEM or channels don't divide into groups."""
+    not fit VMEM or channels don't divide into groups. `kernel_bwd`
+    selects the fused dx kernel (default; env TPU_YARN_NORM_KERNEL_BWD=0
+    flips it) vs recompute-through-reference backward."""
+    from tf_yarn_tpu.ops._rowwise import default_interpret, default_kernel_bwd
+
     c = x.shape[-1]
     hw = 1
     for dim in x.shape[1:-1]:
@@ -169,7 +263,12 @@ def groupnorm(
     if c % groups or hw == 0 or hw * c * 4 > _MAX_SLAB_BYTES:
         return groupnorm_reference(x, scale, bias, groups, eps)
     if interpret is None:
-        from tf_yarn_tpu.ops._rowwise import default_interpret
-
         interpret = default_interpret()
-    return _groupnorm(x, scale, bias, groups, eps, interpret)
+    if kernel_bwd is None:
+        kernel_bwd = default_kernel_bwd()
+    # The bwd kernel streams TWO slabs (x and the cotangent) plus f32
+    # intermediates per block — roughly double the forward footprint, so
+    # it gets half the slab budget; beyond it the backward falls back to
+    # the XLA recompute while the forward stays fused.
+    kernel_bwd = kernel_bwd and (hw * c * 4 * 2 <= _MAX_SLAB_BYTES)
+    return _groupnorm(x, scale, bias, groups, eps, interpret, kernel_bwd)

@@ -11,8 +11,7 @@ vjp ``dx = r·(gs − mean(gs) − norm·mean(gs·norm))`` with all three
 rowwise reductions in VMEM; dscale/dbias are cross-row XLA reductions
 (see ops/rmsnorm.py for the sharding reasoning). kernel_bwd=False keeps
 the recompute-through-reference vjp — the A/B knob; ops/groupnorm.py
-stays recompute-only (its reduction spans spatial dims, outside the
-_rowwise scaffolding).
+carries the same formula per (batch, group) on its slab blocking.
 """
 
 from __future__ import annotations

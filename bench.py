@@ -413,24 +413,20 @@ def bench_flagship_train():
         config = (TransformerConfig(**{**base, **overrides})
                   if overrides is not None else TransformerConfig.tiny())
         model_desc = f"d_model={config.d_model}, layers={config.n_layers}"
-        prior_bwd = os.environ.get("TPU_YARN_NORM_KERNEL_BWD")
-        os.environ["TPU_YARN_NORM_KERNEL_BWD"] = "1" if norm_bwd else "0"
+        from tf_yarn_tpu.benchmark import kernel_bwd_env
+
         try:
-            runs = sorted(
-                (_run_variant(config, batch_size, seq_len, steps, devices)
-                 for _ in range(reps)),
-                key=lambda s: s["samples_per_sec_per_chip"],
-            )
+            with kernel_bwd_env(norm_bwd):
+                runs = sorted(
+                    (_run_variant(config, batch_size, seq_len, steps, devices)
+                     for _ in range(reps)),
+                    key=lambda s: s["samples_per_sec_per_chip"],
+                )
             stats = runs[len(runs) // 2]
         except Exception as exc:  # a broken kernel must not kill the bench
             _log(f"variant {name}: FAILED: {type(exc).__name__}: {exc}")
             table.append({"variant": name, "error": f"{exc}"})
             continue
-        finally:
-            if prior_bwd is None:
-                os.environ.pop("TPU_YARN_NORM_KERNEL_BWD", None)
-            else:
-                os.environ["TPU_YARN_NORM_KERNEL_BWD"] = prior_bwd
         row = {
             "variant": name,
             "samples_per_sec_per_chip": round(

@@ -122,19 +122,13 @@ def bench_bert_base(tpu: bool):
         return loss, {"accuracy": jnp.mean(jnp.argmax(logits, -1) == batch["y"])}
 
     def run_one(variant):
-        import os
+        from tf_yarn_tpu.benchmark import kernel_bwd_env
 
         fused, kernel_bwd = variant
         config = (bert.BertConfig.base(fused_norms=fused) if tpu
                   else bert.BertConfig.tiny(fused_norms=fused))
         model = bert.BertClassifier(config)
-        # Env seam read at trace time (ops/_rowwise.default_kernel_bwd);
-        # each variant builds a fresh jit, so the toggle takes effect.
-        # Restore (not pop) so an operator's global override survives
-        # into the rest of the suite.
-        prior = os.environ.get("TPU_YARN_NORM_KERNEL_BWD")
-        os.environ["TPU_YARN_NORM_KERNEL_BWD"] = "1" if kernel_bwd else "0"
-        try:
+        with kernel_bwd_env(kernel_bwd):
             return measure_throughput(
                 model,
                 loss_fn,
@@ -148,11 +142,6 @@ def bench_bert_base(tpu: bool):
                 init_fn=lambda r, b: model.init(r, b["x"]),
                 steps=10 if tpu else 5,
             )
-        finally:
-            if prior is None:
-                os.environ.pop("TPU_YARN_NORM_KERNEL_BWD", None)
-            else:
-                os.environ["TPU_YARN_NORM_KERNEL_BWD"] = prior
 
     # Post-LN BERT is the norm-heaviest family (2 norms/layer + embedding
     # norm): fused_ln_fwd isolates the forward kernel, fused_ln adds the
@@ -180,30 +169,36 @@ def bench_resnet50(tpu: bool):
     rng = np.random.RandomState(0)
 
     def run_one(spec):
-        stem, batch, fused = spec
+        from tf_yarn_tpu.benchmark import kernel_bwd_env
+
+        stem, batch, fused, gn_bwd = spec
         config = (
             resnet.ResNetConfig.resnet50(stem=stem, fused_norms=fused)
             if tpu
             else resnet.ResNetConfig.tiny(stem=stem, fused_norms=fused))
         model = resnet.ResNet(config)
-        return measure_throughput(
-            model,
-            common.classification_loss,
-            optax.sgd(0.1, momentum=0.9),
-            {
-                "x": rng.randn(batch, size, size, 3).astype(np.float32),
-                "y": rng.randint(
-                    0, config.num_classes, batch).astype(np.int32),
-            },
-            steps=10 if tpu else 5,
-        )
+        with kernel_bwd_env(gn_bwd):
+            return measure_throughput(
+                model,
+                common.classification_loss,
+                optax.sgd(0.1, momentum=0.9),
+                {
+                    "x": rng.randn(batch, size, size, 3).astype(np.float32),
+                    "y": rng.randint(
+                        0, config.num_classes, batch).astype(np.int32),
+                },
+                steps=10 if tpu else 5,
+            )
 
+    # The winning s2d+fused config splits fwd-only vs fwd+bwd GroupNorm
+    # kernels (VERDICT r4 item 5's A/B, resnet edition).
     variants = (
-        [("conv_b64", ("conv", 64, False)),
-         ("s2d_b64", ("space_to_depth", 64, False)),
-         ("s2d_b128", ("space_to_depth", 128, False)),
-         ("s2d_fused_gn_b128", ("space_to_depth", 128, True))]
-        if tpu else [("conv", ("conv", 8, False))]
+        [("conv_b64", ("conv", 64, False, False)),
+         ("s2d_b64", ("space_to_depth", 64, False, False)),
+         ("s2d_b128", ("space_to_depth", 128, False, False)),
+         ("s2d_fused_gn_b128", ("space_to_depth", 128, True, False)),
+         ("s2d_fused_gn_bwd_b128", ("space_to_depth", 128, True, True))]
+        if tpu else [("conv", ("conv", 8, False, False))]
     )
     return _best_of_variants(variants, run_one)
 

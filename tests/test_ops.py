@@ -257,6 +257,44 @@ def test_groupnorm_grad_matches_reference(kernel_bwd, shape, groups):
             np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+def test_groupnorm_kernel_bwd_bf16():
+    from tf_yarn_tpu.ops.groupnorm import groupnorm, groupnorm_reference
+
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(2, 4, 4, 32).astype(np.float32), jnp.bfloat16)
+    scale = jnp.asarray(rng.rand(32).astype(np.float32))
+    bias = jnp.asarray(rng.randn(32).astype(np.float32) * 0.1)
+    g1 = jax.grad(lambda x: groupnorm(x, scale, bias, 4, kernel_bwd=True)
+                  .astype(jnp.float32).sum())(x)
+    g2 = jax.grad(lambda x: groupnorm_reference(x, scale, bias, 4)
+                  .astype(jnp.float32).sum())(x)
+    assert g1.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(g1, np.float32), np.asarray(g2, np.float32), atol=5e-2)
+
+
+def test_groupnorm_grad_fallback_paths():
+    """Empty batch and non-divisible channels route around the kernel
+    (identity / reference) but must still differentiate cleanly."""
+    from tf_yarn_tpu.ops.groupnorm import groupnorm
+
+    scale = jnp.ones((16,))
+    bias = jnp.zeros((16,))
+    gx, gs, gb = jax.grad(
+        lambda x, s, b: groupnorm(x, s, b, 4, kernel_bwd=True).sum(),
+        argnums=(0, 1, 2)
+    )(jnp.zeros((0, 4, 4, 16)), scale, bias)
+    assert gx.shape == (0, 4, 4, 16)
+    assert gs.shape == (16,) and gb.shape == (16,)
+
+    # 18 % 4 != 0 -> ValueError from the reference, not a kernel crash.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="groups"):
+        groupnorm(jnp.zeros((2, 4, 4, 18)), jnp.ones((18,)),
+                  jnp.zeros((18,)), 4)
+
+
 def test_groupnorm_kernel_bwd_partitions_under_pjit():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -9,9 +9,30 @@ as samples/sec/chip.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 from typing import Any, Dict, Optional
+
+
+@contextlib.contextmanager
+def kernel_bwd_env(enabled: bool):
+    """Scoped TPU_YARN_NORM_KERNEL_BWD toggle for A/B variants
+    (ops/_rowwise.default_kernel_bwd reads it at trace time; every
+    measure_throughput builds a fresh jit, so it takes effect). RESTORES
+    the caller's prior value — an operator's global override must
+    survive into the rest of a bench suite."""
+    import os
+
+    prior = os.environ.get("TPU_YARN_NORM_KERNEL_BWD")
+    os.environ["TPU_YARN_NORM_KERNEL_BWD"] = "1" if enabled else "0"
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop("TPU_YARN_NORM_KERNEL_BWD", None)
+        else:
+            os.environ["TPU_YARN_NORM_KERNEL_BWD"] = prior
 
 _logger = logging.getLogger(__name__)
 

@@ -36,9 +36,11 @@ from jax.experimental import pallas as pl
 
 
 def _norm32(x, groups: int, eps: float):
-    """f32 normalized activation (no scale/bias), x's shape — THE
-    per-(batch, group) stats definition, shared by the reference and the
-    kernel-backward's dscale path so a variance/eps fix lands once."""
+    """f32 normalized activation (no scale/bias), x's shape — the
+    XLA-side stats definition (two-pass variance), shared by the
+    reference and the kernel-backward's dscale path. The pallas kernels
+    use the one-pass-clamped _slab_group_stats instead (sum/sumsq fit
+    the slab layout); the two agree to f32 rounding."""
     b, c = x.shape[0], x.shape[-1]
     xg = x.astype(jnp.float32).reshape(b, -1, groups, c // groups)
     mean = jnp.mean(xg, axis=(1, 3), keepdims=True)
@@ -58,28 +60,32 @@ def groupnorm_reference(x, scale, bias, groups: int, eps: float = 1e-5):
             + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _groupnorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *,
-                      groups: int, eps: float):
-    x = x_ref[...].astype(jnp.float32)  # [1, HW, C] block: one batch elem
-    hw, c = x.shape[-2], x.shape[-1]
-    cg = c // groups
-    x2d = x.reshape(hw, c)
-    # One-hot channel->group assignment, built from iota (no gathers).
-    assign = _group_assign(c, groups)  # [C, G]
-    # Per-channel sums -> per-group stats via the assignment matmul.
-    sum_c = jnp.sum(x2d, axis=0)          # [C]
-    sumsq_c = jnp.sum(x2d * x2d, axis=0)  # [C]
-    n = jnp.float32(hw * cg)
-    mean_g = (sum_c @ assign) / n                     # [G]
+def _slab_group_stats(x2d, assign, groups: int, eps: float):
+    """(mean_c, inv_c) per channel for one [HW, C] slab — the in-kernel
+    stats definition, shared by the forward and dx kernels. Per-channel
+    sums fold into per-group stats via the assignment matmul (lane dim
+    stays C) and broadcast back with its transpose."""
+    hw, c = x2d.shape
+    n = jnp.float32(hw * (c // groups))
+    mean_g = (jnp.sum(x2d, axis=0) @ assign) / n  # [G]
     # One-pass variance can round negative under f32 cancellation (large
     # mean, tiny spread: ulp at 1e6 is ~0.06); clamp like flax's
     # use_fast_variance path or rsqrt(negative) poisons the slab with NaN.
     var_g = jnp.maximum(
-        (sumsq_c @ assign) / n - mean_g * mean_g, 0.0)  # [G]
+        (jnp.sum(x2d * x2d, axis=0) @ assign) / n - mean_g * mean_g, 0.0)
     inv_g = jax.lax.rsqrt(var_g + eps)
     # Broadcast group stats back onto channels: [G] @ [G, C].
-    mean_c = mean_g @ assign.T
-    inv_c = inv_g @ assign.T
+    return mean_g @ assign.T, inv_g @ assign.T
+
+
+def _groupnorm_kernel(x_ref, scale_ref, bias_ref, o_ref, *,
+                      groups: int, eps: float):
+    x = x_ref[...].astype(jnp.float32)  # [1, HW, C] block: one batch elem
+    hw, c = x.shape[-2], x.shape[-1]
+    x2d = x.reshape(hw, c)
+    # One-hot channel->group assignment, built from iota (no gathers).
+    assign = _group_assign(c, groups)  # [C, G]
+    mean_c, inv_c = _slab_group_stats(x2d, assign, groups, eps)
     y = (x2d - mean_c[None, :]) * inv_c[None, :]
     y = y * scale_ref[...].astype(jnp.float32)[None, :]
     y = y + bias_ref[...].astype(jnp.float32)[None, :]
@@ -150,12 +156,7 @@ def _groupnorm_bwd_dx_kernel(x_ref, g_ref, scale_ref, o_ref, *,
     gs = g2d * scale_ref[...].astype(jnp.float32)[None, :]
     assign = _group_assign(c, groups)
     n = jnp.float32(hw * (c // groups))
-    mean_g = (jnp.sum(x2d, axis=0) @ assign) / n
-    var_g = jnp.maximum(
-        (jnp.sum(x2d * x2d, axis=0) @ assign) / n - mean_g * mean_g, 0.0)
-    inv_g = jax.lax.rsqrt(var_g + eps)
-    mean_c = mean_g @ assign.T
-    inv_c = inv_g @ assign.T
+    mean_c, inv_c = _slab_group_stats(x2d, assign, groups, eps)
     norm = (x2d - mean_c[None, :]) * inv_c[None, :]
     m1_c = ((jnp.sum(gs, axis=0) @ assign) / n) @ assign.T
     m2_c = ((jnp.sum(gs * norm, axis=0) @ assign) / n) @ assign.T

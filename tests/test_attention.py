@@ -267,3 +267,27 @@ def test_flash_attention_partitions_batch_under_pjit():
     gref = jax.grad(
         lambda q: attention(q, k, v, impl="xla", causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(grad), np.asarray(gref), atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_key_padding_mask_matches_truncated(causal):
+    """Masked-out trailing keys must be invisible: queries over the real
+    prefix produce the same output as attention over the truncated
+    sequence (the padded-batch encoder contract)."""
+    q, k, v = _qkv(b=2, s=64)
+    real = 40
+    mask = jnp.zeros((2, 64), jnp.int32).at[:, :real].set(1)
+    full = attention(q, k, v, impl="xla", causal=causal,
+                     key_padding_mask=mask)
+    trunc = attention(q[:, :real], k[:, :real], v[:, :real], impl="xla",
+                      causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(full[:, :real]), np.asarray(trunc), atol=1e-5)
+
+
+def test_key_padding_mask_rejected_on_kernel_impls():
+    q, k, v = _qkv()
+    mask = jnp.ones((2, 64), jnp.int32)
+    for impl in ("flash", "ring", "ulysses"):
+        with pytest.raises(NotImplementedError, match="key_padding_mask"):
+            attention(q, k, v, impl=impl, key_padding_mask=mask)

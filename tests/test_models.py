@@ -479,3 +479,27 @@ def test_hash_features_deterministic():
     assert (h1 == h2).all()
     assert h1.shape == (2, 2)
     assert h1[0, 0] == h2[1, 0]
+
+
+def test_bert_attention_mask_hides_padding():
+    """Padded-batch contract: logits with [real tokens + padding +
+    attention_mask] equal logits on the unpadded sequence — padding
+    cannot leak into any real token's attention."""
+    cfg = bert.BertConfig.tiny()
+    model = bert.BertClassifier(cfg)
+    rng = np.random.RandomState(7)
+    real = jnp.asarray(rng.randint(1, cfg.vocab_size, (2, 10)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), real)
+
+    padded = jnp.pad(real, ((0, 0), (0, 6)))  # 6 pad tokens (id 0)
+    mask = jnp.zeros((2, 16), jnp.int32).at[:, :10].set(1)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(variables, padded, attention_mask=mask)),
+        np.asarray(model.apply(variables, real)),
+        atol=1e-4,
+    )
+    # Without the mask, padding DOES change the logits (the gap this
+    # feature closes) — guards against the mask silently no-op'ing.
+    unmasked = np.asarray(model.apply(variables, padded))
+    assert not np.allclose(
+        unmasked, np.asarray(model.apply(variables, real)), atol=1e-4)

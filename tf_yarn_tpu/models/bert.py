@@ -95,7 +95,7 @@ class EncoderBlock(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, attention_mask=None):
         cfg = self.config
         b, s, _ = x.shape
         q = _Dense(cfg.d_model, (EMBED, HEADS), cfg, name="wq")(x)
@@ -104,7 +104,8 @@ class EncoderBlock(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        out = attention(q, k, v, impl=cfg.attention_impl, causal=False)
+        out = attention(q, k, v, impl=cfg.attention_impl, causal=False,
+                        key_padding_mask=attention_mask)
         out = _Dense(cfg.d_model, (HEADS, EMBED), cfg, name="wo")(
             out.reshape(b, s, cfg.d_model)
         )
@@ -119,12 +120,16 @@ class EncoderBlock(nn.Module):
 
 
 class BertEncoder(nn.Module):
-    """tokens [B,S] (+ optional segments [B,S]) -> pooled [B, d_model]."""
+    """tokens [B,S] (+ optional segments [B,S], attention_mask [B,S] with
+    1 = real token) -> pooled [B, d_model]. The mask is the HuggingFace-
+    style padded-batch contract: padded keys are hidden from every real
+    token's attention (requires attention_impl='xla')."""
 
     config: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, segments=None, deterministic: bool = True):
+    def __call__(self, tokens, segments=None, deterministic: bool = True,
+                 attention_mask=None):
         cfg = self.config
         tok_emb = self.param(
             "token_embedding",
@@ -152,7 +157,8 @@ class BertEncoder(nn.Module):
         x = BertNorm(cfg, name="embed_norm")(x)
         x = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)(x)
         for i in range(cfg.n_layers):
-            x = EncoderBlock(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+            x = EncoderBlock(cfg, name=f"layer_{i}")(
+                x, deterministic=deterministic, attention_mask=attention_mask)
         # [CLS] pooling + tanh, classic BERT pooler.
         pooled = _Dense(cfg.d_model, (EMBED, None), cfg, name="pooler")(x[:, 0])
         return jnp.tanh(pooled)
@@ -162,9 +168,12 @@ class BertClassifier(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 attention_mask=None):
         cfg = self.config
-        pooled = BertEncoder(cfg, name="encoder")(tokens, deterministic=deterministic)
+        pooled = BertEncoder(cfg, name="encoder")(
+            tokens, deterministic=deterministic,
+            attention_mask=attention_mask)
         logits = _Dense(cfg.num_classes, (EMBED, None), cfg, name="classifier")(pooled)
         return logits.astype(jnp.float32)
 
@@ -181,7 +190,8 @@ def make_experiment(
     **train_param_overrides,
 ):
     """Sequence-classification fine-tune (synthetic tokens unless input_fn
-    yields {"x": tokens, "y": labels})."""
+    yields {"x": tokens, "y": labels} — add "mask": [B,S] 1/0 for padded
+    batches and it threads through to key-padding attention)."""
     import numpy as np
     import optax
 
@@ -199,7 +209,8 @@ def make_experiment(
 
     def loss_fn(model, params, batch, rng, train=True):
         logits = model.apply(params, batch["x"], rngs={"dropout": rng},
-                             deterministic=not train)
+                             deterministic=not train,
+                             attention_mask=batch.get("mask"))
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["y"]
         ).mean()

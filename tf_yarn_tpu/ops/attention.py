@@ -39,11 +39,14 @@ def xla_attention(
     causal: bool = True,
     softmax_scale: float | None = None,
     segment_offset: int = 0,
+    key_padding_mask: jax.Array | None = None,
 ) -> jax.Array:
     """Reference attention: q [B,S,H,D], k/v [B,Skv,Hkv,D] -> [B,S,H,D].
 
     `segment_offset` shifts the causal mask for sequence-sharded callers
     (ring attention evaluates blocks whose global positions start there).
+    `key_padding_mask` [B, S_kv] (1/True = real token) hides padded keys
+    from every query — the encoder-family batching contract.
     Softmax runs in f32 regardless of input dtype — the bf16-safe pattern.
     """
     b, s_q, n_heads, head_dim = query.shape
@@ -52,17 +55,32 @@ def xla_attention(
     scale = softmax_scale if softmax_scale is not None else head_dim**-0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", query, key) * scale
     logits = logits.astype(jnp.float32)
+    neg_inf = jnp.finfo(jnp.float32).min
     if causal:
         q_pos = jnp.arange(s_q)[:, None] + segment_offset
         k_pos = jnp.arange(s_kv)[None, :]
         mask = q_pos >= k_pos
-        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+        logits = jnp.where(mask[None, None], logits, neg_inf)
+    if key_padding_mask is not None:
+        keep = key_padding_mask.astype(bool)[:, None, None, :]  # [B,1,1,Skv]
+        logits = jnp.where(keep, logits, neg_inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
 
 
-def attention(query, key, value, *, impl: str = "xla", causal: bool = True):
-    """Dispatch to the configured backend."""
+def attention(query, key, value, *, impl: str = "xla", causal: bool = True,
+              key_padding_mask=None):
+    """Dispatch to the configured backend. `key_padding_mask` is an
+    xla-impl feature (the flash/ring/ulysses kernels have no arbitrary-
+    mask path — their masking is structural/causal); passing one there
+    raises rather than silently attending to padding."""
+    known = ("xla", "flash", "ring", "ulysses", "ulysses_flash")
+    if key_padding_mask is not None and impl in known[1:]:
+        raise NotImplementedError(
+            f"key_padding_mask is not supported by attention impl "
+            f"{impl!r}; use impl='xla' for padded-batch encoders (or "
+            "strip padding before a kernel impl)"
+        )
     if impl == "flash":
         from tf_yarn_tpu.ops.flash_attention import flash_attention
 
@@ -83,4 +101,5 @@ def attention(query, key, value, *, impl: str = "xla", causal: bool = True):
             f"unknown attention impl {impl!r}; "
             "use xla | flash | ring | ulysses | ulysses_flash"
         )
-    return xla_attention(query, key, value, causal=causal)
+    return xla_attention(query, key, value, causal=causal,
+                         key_padding_mask=key_padding_mask)

@@ -112,6 +112,24 @@ def test_gather_batches_bound_ram_not_collective_count():
     ]
 
 
+def test_batch_budget_takes_fleet_minimum(monkeypatch):
+    """The gather batch budget must be IDENTICAL on every host (different
+    boundaries desynchronize the collectives), so the agreement takes the
+    min of all hosts' RAM-derived offers."""
+    offers = {}
+    real_plan = ckpt_lib._plan_gather_batches
+
+    def spy_plan(sized, budget):
+        offers["budget"] = budget
+        return real_plan(sized, budget)
+
+    monkeypatch.setattr(ckpt_lib, "_plan_gather_batches", spy_plan)
+    # Peer offers a 1 KB budget; ours (RAM-derived) is far larger.
+    _FakeWorld(monkeypatch, peer_flags=(1, 0, 1024), index=0)
+    ckpt_lib._snapshot_for_staging({"w": np.ones((8,), np.float32)})
+    assert offers["budget"] == 1024
+
+
 def test_non_uploader_retains_nothing(monkeypatch):
     _FakeWorld(monkeypatch, index=1)
     snap, uploader = ckpt_lib._snapshot_for_staging(

@@ -68,6 +68,11 @@ def generate(
     created by the prefill apply (sized config.max_seq_len) and threaded
     through a jitted decode step. Returns [B, P + max_new_tokens] int32
     (positions after an eos_token, if given, repeat eos).
+
+    All prompts in a batch share length P (the prefill writes one cache
+    offset for the whole batch). For ragged prompts, bucket requests by
+    length (inference.py batches this way) — left-padding with per-row
+    cache offsets is not supported.
     """
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     b, prompt_len = prompt_tokens.shape

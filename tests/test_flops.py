@@ -161,3 +161,31 @@ def test_measure_throughput_reports_flops():
     assert stats["model_flops_per_step_per_chip"] > 0
     # CPU rig: no peak table entry, so no MFU claim.
     assert "mfu" not in stats
+
+
+def test_kernel_bwd_env_restores_operator_override(monkeypatch):
+    """The A/B toggle must restore a pre-set global override (an operator
+    benchmarking the whole suite on one backward mode), and remove the
+    variable entirely when none was set."""
+    import os
+
+    from tf_yarn_tpu.benchmark import kernel_bwd_env
+
+    monkeypatch.delenv("TPU_YARN_NORM_KERNEL_BWD", raising=False)
+    with kernel_bwd_env(False):
+        assert os.environ["TPU_YARN_NORM_KERNEL_BWD"] == "0"
+    assert "TPU_YARN_NORM_KERNEL_BWD" not in os.environ
+
+    monkeypatch.setenv("TPU_YARN_NORM_KERNEL_BWD", "0")
+    with kernel_bwd_env(True):
+        assert os.environ["TPU_YARN_NORM_KERNEL_BWD"] == "1"
+    assert os.environ["TPU_YARN_NORM_KERNEL_BWD"] == "0"
+
+    # Restores even when the body raises (one failed variant must not
+    # poison the rest of the sweep).
+    try:
+        with kernel_bwd_env(True):
+            raise RuntimeError("variant failed")
+    except RuntimeError:
+        pass
+    assert os.environ["TPU_YARN_NORM_KERNEL_BWD"] == "0"

@@ -81,22 +81,59 @@ def _code_hash() -> str:
     return digest.hexdigest()[:12]
 
 
-def _prior_round_cpu_value():
-    """(round file, value) of the newest driver-recorded CPU-fallback
-    headline, for drift detection across rounds (round-4 verdict weak
-    #2: 521.9 -> 456.4 samples/s went unnoticed and unexplained)."""
-    import glob
+def _uncommitted_bench_files() -> set:
+    """Basenames of BENCH_r*.json not committed to HEAD. Prior rounds'
+    files are committed by the end-of-round snapshot; anything untracked
+    or modified belongs to the round in flight."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", _REPO, "status", "--porcelain", "--",
+             "BENCH_r*.json"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode:
+            return set()
+        return {
+            os.path.basename(line[3:].strip())
+            for line in out.stdout.splitlines()
+            if line.strip()
+        }
+    except Exception:
+        return set()
 
-    found = None
-    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))):
+
+def _prior_round_cpu_value():
+    """(round file, value) of the newest PRIOR round's driver-recorded
+    CPU-fallback headline, for drift detection across rounds (round-4
+    verdict weak #2: 521.9 -> 456.4 samples/s went unnoticed and
+    unexplained).
+
+    Two traps (ADVICE r5 item 1): the current round's own file is
+    already on disk on a re-run within a round — comparing against it
+    mutes the cross-round signal, so uncommitted files are excluded —
+    and lexical glob order silently depends on zero-padded round
+    numbers, so candidates sort by the *parsed* round number.
+    """
+    import glob
+    import re
+
+    candidates = []
+    for path in glob.glob(os.path.join(_REPO, "BENCH_r*.json")):
+        match = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if match:
+            candidates.append((int(match.group(1)), path))
+    current_round = _uncommitted_bench_files()
+    for _round_num, path in sorted(candidates, reverse=True):
+        if os.path.basename(path) in current_round:
+            continue
         try:
             with open(path) as fh:
                 parsed = json.load(fh).get("parsed") or {}
         except (OSError, ValueError):
             continue
         if "cpu-fallback" in str(parsed.get("unit", "")) and parsed.get("value"):
-            found = (os.path.basename(path), float(parsed["value"]))
-    return found
+            return (os.path.basename(path), float(parsed["value"]))
+    return None
 
 
 def _log(*args) -> None:

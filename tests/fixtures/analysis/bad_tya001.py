@@ -1,0 +1,15 @@
+"""TYA001: host side effects inside a jit body."""
+import logging
+
+import jax
+
+_logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def step(x):
+    print("tracing", x)
+    _logger.info("step %s", x)
+    with open("/tmp/trace.log", "w") as fh:
+        fh.write("once, at trace time")
+    return x * 2

@@ -1,0 +1,12 @@
+"""TYA002: host timing inside a jit body measures trace time."""
+import time
+
+import jax
+
+
+@jax.jit
+def timed_step(x):
+    t0 = time.time()
+    y = x * 2
+    elapsed = time.perf_counter() - t0
+    return y, elapsed

@@ -1,0 +1,10 @@
+"""TYA003: host numpy computation on traced values inside jit."""
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def normalize(x):
+    mean = np.mean(x)
+    return (x - mean) / np.std(x)

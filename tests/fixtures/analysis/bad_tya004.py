@@ -1,0 +1,23 @@
+"""TYA004: global/nonlocal mutation inside a jit body runs once."""
+import jax
+
+_step_count = 0
+
+
+@jax.jit
+def counted_step(x):
+    global _step_count
+    _step_count += 1
+    return x + 1
+
+
+def make_step():
+    calls = 0
+
+    @jax.jit
+    def inner(x):
+        nonlocal calls
+        calls += 1
+        return x
+
+    return inner

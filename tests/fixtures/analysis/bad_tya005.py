@@ -1,0 +1,13 @@
+"""TYA005: Python truthiness of a traced jnp expression inside jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_if_nonfinite(x):
+    if jnp.any(jnp.isnan(x)):
+        x = jnp.zeros_like(x)
+    while jnp.max(x) > 10.0:
+        x = x * 0.5
+    assert jnp.all(x < 100.0)
+    return x

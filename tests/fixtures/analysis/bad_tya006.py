@@ -1,0 +1,26 @@
+"""TYA006: collective/PartitionSpec axis literals no mesh declares.
+
+The mesh here declares ("data", "model"); every use below names
+something else — the axis-typo class XLA only reports at trace time.
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1, 1), ("data", "model"))
+
+
+def bad_mean(x):
+    return jax.lax.pmean(x, "dta")  # typo of "data"
+
+
+def bad_gather(x):
+    return jax.lax.all_gather(x, axis_name="modle", tiled=True)
+
+
+def bad_index():
+    return jax.lax.axis_index("batch")
+
+
+BAD_SPEC = P("dat", None)

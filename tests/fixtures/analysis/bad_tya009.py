@@ -1,0 +1,10 @@
+"""TYA009: device transfer / host sync inside a jit body."""
+import jax
+
+
+@jax.jit
+def sync_step(x):
+    y = x * 2
+    jax.device_put(y)
+    y.block_until_ready()
+    return float(y.item())

@@ -1,0 +1,13 @@
+"""TYA010: host RNG inside a jit body freezes one sample forever."""
+import random
+
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def noisy_step(x):
+    noise = np.random.normal(size=x.shape)
+    scale = random.uniform(0.9, 1.1)
+    return x * scale + noise

@@ -1,0 +1,76 @@
+"""The legitimate twin of every bad fixture: zero findings expected.
+
+Everything here is idiomatic JAX the checker must NOT flag — host work
+outside jit, static-Python control flow inside jit, declared axis
+names, donated train state, narrow exception handling, jax.random.
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+mesh = Mesh(
+    np.asarray(jax.devices()).reshape(-1, 1), (AXIS_DATA, AXIS_MODEL)
+)
+
+# Host numpy, printing, timing, RNG — all fine OUTSIDE jit.
+host_input = np.random.RandomState(0).normal(size=(8, 4))
+t0 = time.time()
+print("setup done in", time.time() - t0)
+
+
+@jax.jit
+def step(x, *, causal: bool = True):
+    # Static Python control flow on a non-traced argument is fine.
+    if causal:
+        x = jnp.tril(x)
+    # jnp compute on traced values is the whole point.
+    return jnp.where(x > 0, x, 0.0)
+
+
+def train_step(state, batch, rng):
+    return state, {"loss": jnp.float32(0.0)}
+
+
+# Donated train state: the pattern TYA007 wants.
+compiled = jax.jit(train_step, donate_argnums=(0,))
+
+
+def reduce_over_declared_axes(x):
+    # Declared axis names pass the vocabulary check.
+    total = jax.lax.psum(x, AXIS_DATA)
+    mean = jax.lax.pmean(x, "data")
+    return total, mean, P("data", "model")
+
+
+def restore(path):
+    try:
+        with open(path, "rb") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+@jax.jit
+def random_step(x, rng):
+    # Traced RNG: the jax.random way.
+    return x + jax.random.normal(rng, x.shape)
+
+
+def host_sync(fn, x):
+    # Transfers and syncs OUTSIDE jit are normal.
+    y = jax.device_put(x)
+    out = fn(y)
+    out.block_until_ready()
+    return float(out.sum())
+
+
+def suppressed_example(x):
+    # An exotic-but-intended axis literal, explicitly waived.
+    return jax.lax.psum(x, "exotic")  # noqa: TYA006

@@ -1,0 +1,211 @@
+"""The static checker checks itself: every rule flags its bad fixture,
+the clean fixture stays clean (false-positive guard), the repo passes
+its own checker (the CI gate — any future PR introducing a flagged
+pattern fails here), and the jaxpr engine verifies the collectives
+wrappers' axis discipline."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tf_yarn_tpu.analysis.ast_engine import (
+    analyze_paths,
+    collect_declared_axes,
+)
+from tf_yarn_tpu.analysis.findings import Finding, noqa_lines
+from tf_yarn_tpu.analysis.rules import RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+AST_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "ast")
+
+
+# --- AST engine: each rule fires on its fixture, and only its rule -------
+
+@pytest.mark.parametrize("code", AST_RULES)
+def test_bad_fixture_flags_exactly_its_rule(code):
+    path = os.path.join(FIXTURES, f"bad_{code.lower()}.py")
+    findings = analyze_paths([path])
+    codes = {f.code for f in findings}
+    assert codes == {code}, (
+        f"{path} expected only {code}, got {sorted(codes)}: "
+        f"{[f.format() for f in findings]}"
+    )
+    assert len(findings) >= 1
+
+
+def test_clean_fixture_has_no_findings():
+    findings = analyze_paths([os.path.join(FIXTURES, "clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_every_ast_rule_has_a_fixture():
+    for code in AST_RULES:
+        assert os.path.exists(
+            os.path.join(FIXTURES, f"bad_{code.lower()}.py")
+        ), f"no fixture for {code}"
+
+
+def test_noqa_suppresses_matching_code_only(tmp_path):
+    src = (
+        "import jax\n"
+        'a = jax.lax.psum(1.0, "zz")  # noqa: TYA006\n'
+        'b = jax.lax.psum(1.0, "qq")  # noqa\n'
+        'c = jax.lax.psum(1.0, "ww")  # noqa: TYA001\n'
+    )
+    path = tmp_path / "noqa_case.py"
+    path.write_text(src)
+    findings = analyze_paths([str(path)])
+    assert [f.code for f in findings] == ["TYA006"]
+    assert findings[0].line == 4
+
+
+def test_noqa_inside_string_literal_is_not_a_suppression():
+    sup = noqa_lines('x = "contains # noqa: TYA006 in a string"\n')
+    assert sup == {}
+
+
+def test_declared_axis_collection():
+    import ast
+
+    tree = ast.parse(
+        'AXIS_X = "xx"\n'
+        "from jax.sharding import Mesh\n"
+        'm = Mesh(devs, ("aa", "bb"))\n'
+        'def f(v, axis="cc"):\n'
+        "    return v\n"
+        "class S:\n"
+        "    @property\n"
+        "    def axis_names(self):\n"
+        '        return ("dd", "ee")\n'
+    )
+    assert collect_declared_axes([tree]) == {"xx", "aa", "bb", "cc", "dd", "ee"}
+
+
+# --- the repo gates itself ------------------------------------------------
+
+def _run_checker(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tf_yarn_tpu.analysis", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_repo_passes_its_own_checker():
+    proc = _run_checker("tf_yarn_tpu")
+    assert proc.returncode == 0, (
+        "the checker found problems in tf_yarn_tpu/ — fix them or "
+        f"suppress with # noqa: TYA0xx:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_fixtures_fail_the_checker():
+    proc = _run_checker(FIXTURES, "--no-jaxpr")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    # every AST rule shows up in the aggregate run
+    for code in AST_RULES:
+        assert code in proc.stdout, f"{code} missing from:\n{proc.stdout}"
+
+
+def test_checker_json_output():
+    import json
+
+    proc = _run_checker(FIXTURES, "--no-jaxpr", "--json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["n_findings"] == len(payload["findings"]) > 0
+    assert {f["code"] for f in payload["findings"]} >= set(AST_RULES)
+
+
+# --- jaxpr engine ---------------------------------------------------------
+
+def test_jaxpr_engine_collectives_verify_clean():
+    from tf_yarn_tpu.analysis.jaxpr_engine import _collective_entries, run
+
+    findings, counts, skipped = run(_collective_entries())
+    assert findings == [], [f.format() for f in findings]
+    assert skipped == []
+    assert counts["parallel.collectives.all_reduce_sum"]["psum"] == 1
+    assert counts["parallel.collectives.ring_shift"]["ppermute"] == 1
+    assert counts["parallel.collectives.all_gather"]["all_gather"] == 1
+
+
+def test_jaxpr_engine_flags_axis_outside_expected():
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.analysis.jaxpr_engine import EntryPoint, check_entry
+
+    def build():
+        from tf_yarn_tpu.parallel import collectives
+
+        return (
+            lambda x: collectives.all_reduce_sum(x, "tp"),
+            (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            {},
+        )
+
+    entry = EntryPoint(
+        "test.wrong_axis", build,
+        axis_env=(("dp", 2), ("tp", 2)), expected_axes=("dp",),
+    )
+    findings, _counts = check_entry(entry)
+    assert [f.code for f in findings] == ["TYA102"]
+    assert "'tp'" in findings[0].message
+
+
+def test_jaxpr_engine_flags_unbound_axis_as_trace_failure():
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.analysis.jaxpr_engine import EntryPoint, check_entry
+
+    def build():
+        return (
+            lambda x: jax.lax.psum(x, "dpp"),  # noqa: TYA006 - deliberate
+            (jax.ShapeDtypeStruct((4,), jnp.float32),),
+            {},
+        )
+
+    entry = EntryPoint("test.typo", build, axis_env=(("dp", 2),))
+    findings, _counts = check_entry(entry)
+    assert [f.code for f in findings] == ["TYA101"]
+
+
+def test_jaxpr_engine_flags_host_callback_in_hot_path():
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.analysis.jaxpr_engine import EntryPoint, check_entry
+
+    def build():
+        def chatty(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        return chatty, (jax.ShapeDtypeStruct((4,), jnp.float32),), {}
+
+    entry = EntryPoint("test.chatty", build)
+    findings, counts = check_entry(entry)
+    assert [f.code for f in findings] == ["TYA103"]
+    assert counts.get("debug_callback") == 1
+
+
+def test_jaxpr_engine_default_entries_clean_on_this_build():
+    from tf_yarn_tpu.analysis.jaxpr_engine import run
+
+    findings, counts, skipped = run()
+    assert findings == [], [f.format() for f in findings]
+    # the flagship model traced: lowering regressions show as count diffs
+    assert "models.transformer.fwd_bwd" in counts
+    assert counts["models.transformer.fwd_bwd"]["dot_general"] > 0
+
+
+def test_finding_format_and_json_roundtrip():
+    finding = Finding("TYA006", "msg", "a/b.py", 3, 7)
+    assert finding.format() == "a/b.py:3:7: TYA006 msg"
+    assert finding.to_json()["line"] == 3

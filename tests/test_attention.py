@@ -285,6 +285,24 @@ def test_key_padding_mask_matches_truncated(causal):
         np.asarray(full[:, :real]), np.asarray(trunc), atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_fully_padded_row_outputs_zero(causal):
+    """A batch row whose key_padding_mask is all zeros has no real keys:
+    its outputs must be exactly zero, not the silent uniform softmax
+    over finfo.min logits (ADVICE r5 item 4). Rows with real keys are
+    unaffected."""
+    q, k, v = _qkv(b=3, s=16)
+    mask = jnp.ones((3, 16), jnp.int32).at[1].set(0)  # row 1 fully padded
+    out = attention(q, k, v, impl="xla", causal=causal,
+                    key_padding_mask=mask)
+    np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+    # the live rows match a run without the dead row
+    ref = attention(q[::2], k[::2], v[::2], impl="xla", causal=causal,
+                    key_padding_mask=mask[::2])
+    np.testing.assert_allclose(np.asarray(out[::2]), np.asarray(ref),
+                               atol=1e-6)
+
+
 def test_key_padding_mask_rejected_on_kernel_impls():
     q, k, v = _qkv()
     mask = jnp.ones((2, 64), jnp.int32)

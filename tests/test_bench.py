@@ -1,0 +1,70 @@
+"""bench.py prior-round lookup: numeric round ordering + exclusion of
+the current round's own (uncommitted) file (ADVICE r5 item 1)."""
+
+import json
+import os
+
+import bench
+
+
+def _write_round(tmp_path, n, value, unit="samples/sec/chip (cpu-fallback)"):
+    path = tmp_path / f"BENCH_r{n}.json"
+    path.write_text(json.dumps({"parsed": {"value": value, "unit": unit}}))
+    return path.name
+
+
+def test_prior_round_sorts_by_parsed_round_number(tmp_path, monkeypatch):
+    # Lexically "BENCH_r2.json" > "BENCH_r10.json": glob order would pick
+    # round 2 as "newest". Parsed-number order must pick round 10.
+    _write_round(tmp_path, 2, 2.0)
+    _write_round(tmp_path, 10, 10.0)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_uncommitted_bench_files", lambda: set())
+    assert bench._prior_round_cpu_value() == ("BENCH_r10.json", 10.0)
+
+
+def test_prior_round_excludes_current_rounds_own_file(tmp_path, monkeypatch):
+    # A re-run within round 10 sees its own file on disk; comparing
+    # against it would mute the cross-round drift signal.
+    _write_round(tmp_path, 9, 9.0)
+    _write_round(tmp_path, 10, 10.0)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(
+        bench, "_uncommitted_bench_files", lambda: {"BENCH_r10.json"}
+    )
+    assert bench._prior_round_cpu_value() == ("BENCH_r9.json", 9.0)
+
+
+def test_prior_round_skips_non_cpu_fallback_units(tmp_path, monkeypatch):
+    _write_round(tmp_path, 3, 3.0)
+    _write_round(tmp_path, 4, 4.0, unit="samples/sec/chip (tpu, flash)")
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_uncommitted_bench_files", lambda: set())
+    assert bench._prior_round_cpu_value() == ("BENCH_r3.json", 3.0)
+
+
+def test_prior_round_none_when_no_candidates(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "_uncommitted_bench_files", lambda: set())
+    assert bench._prior_round_cpu_value() is None
+
+
+def test_uncommitted_detection_outside_git_repo(tmp_path, monkeypatch):
+    # Outside a git repo the helper must degrade to "nothing excluded",
+    # not crash the bench.
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    assert bench._uncommitted_bench_files() == set()
+
+
+def test_uncommitted_detection_in_real_repo():
+    # In THIS repo: a scratch BENCH_r file is untracked, so it is
+    # excluded; committed rounds are not.
+    scratch = os.path.join(bench._REPO, "BENCH_r999.json")
+    with open(scratch, "w") as fh:
+        json.dump({}, fh)
+    try:
+        uncommitted = bench._uncommitted_bench_files()
+        assert "BENCH_r999.json" in uncommitted
+        assert "BENCH_r01.json" not in uncommitted
+    finally:
+        os.unlink(scratch)

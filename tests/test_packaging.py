@@ -278,6 +278,26 @@ def test_build_wheelhouse_memoized_and_includes_sdists(tmp_path):
     assert packaging.build_wheelhouse(wheels_dir=str(dl)) != first
 
 
+def test_build_wheelhouse_bare_spec_string_raises_contract_error(tmp_path):
+    """requirements="numpy==1.26" is the natural mis-call of the
+    list-vs-path contract: it must raise a ValueError naming the
+    contract, not a FileNotFoundError from getmtime (ADVICE r5 item 3)."""
+    import pytest
+
+    with pytest.raises(ValueError, match="list"):
+        packaging.build_wheelhouse(requirements="numpy==1.26")
+    # An existing requirements.txt path keeps working as a path.
+    req = tmp_path / "requirements.txt"
+    req.write_text("deppkg\n")
+    from tests._wheels import make_wheel
+
+    make_wheel(str(tmp_path / "dl"))
+    house = packaging.build_wheelhouse(
+        requirements=str(req), wheels_dir=str(tmp_path / "dl"))
+    with open(os.path.join(house, packaging.WHEELHOUSE_MANIFEST)) as fh:
+        assert fh.read().split() == ["deppkg"]
+
+
 def test_pip_install_cmd_uses_backend_python():
     cmd = packaging._pip_install_cmd(
         "~/code/_wheels", "~/code/_pydeps", python="/opt/py/bin/python")

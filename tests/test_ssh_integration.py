@@ -209,9 +209,22 @@ def test_requirements_ship_via_file_channel(tmp_path):
     )
     assert metrics is not None
     assert set(metrics.container_duration) == {"worker:0", "worker:1"}
-    # Each task workdir got its own offline install.
-    installed = list((fake_home / ".tpu_yarn_runs").rglob("_pydeps/deppkg.py"))
+    # Each task workdir got its own offline install, under a
+    # content-addressed _pydeps/<wheelhouse digest>/ target (a reused
+    # workdir with changed wheels reinstalls instead of importing stale
+    # deps).
+    installed = [
+        p
+        for p in (fake_home / ".tpu_yarn_runs").rglob("deppkg.py")
+        if "_pydeps" in p.parts
+    ]
     assert len(installed) == 2
+    for path in installed:
+        digest_dir = path.parent.name
+        assert path.parent.parent.name == "_pydeps"
+        assert len(digest_dir) == 12 and all(
+            c in "0123456789abcdef" for c in digest_dir
+        )
 
 
 def test_requirements_ship_via_staging_dir(tmp_path):

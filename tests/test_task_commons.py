@@ -90,3 +90,56 @@ def test_get_experiment_failure_emits_events(monkeypatch):
         _task_commons.get_experiment(kv)
     assert kv.get_str("worker:0/start") == ""
     assert "bad experiment" in kv.get_str("worker:0/stop")
+
+
+def test_wheelhouse_digest_content_addressed(tmp_path):
+    """The _pydeps install target is keyed by wheelhouse CONTENT
+    (ADVICE r5 item 2): same wheels -> same digest (marker reused),
+    changed or added wheels -> new digest (fresh install, no stale
+    deps from a reused workdir)."""
+    house = tmp_path / "_shipped_wheels"
+    house.mkdir()
+    (house / "dep-1.0-py3-none-any.whl").write_bytes(b"wheel-one")
+    first = _task_commons._wheelhouse_digest(str(house))
+    assert first == _task_commons._wheelhouse_digest(str(house))
+    assert len(first) == 12
+
+    (house / "dep-1.0-py3-none-any.whl").write_bytes(b"wheel-two")
+    changed = _task_commons._wheelhouse_digest(str(house))
+    assert changed != first
+
+    (house / "extra-0.1-py3-none-any.whl").write_bytes(b"more")
+    assert _task_commons._wheelhouse_digest(str(house)) != changed
+
+
+def test_install_shipped_wheels_reinstalls_on_changed_house(
+    tmp_path, monkeypatch
+):
+    """End-to-end marker semantics without pip: a changed wheelhouse
+    must route to a DIFFERENT _pydeps/<digest> target (so the old
+    marker cannot suppress the new install)."""
+    calls = []
+
+    def fake_run(cmd, check):
+        # record the --target pip would install into
+        calls.append(cmd[cmd.index("--target") + 1])
+
+        class _Done:
+            returncode = 0
+
+        return _Done()
+
+    monkeypatch.chdir(tmp_path)
+    # _install_shipped_wheels imports subprocess inside the function;
+    # patch the module attribute it will resolve.
+    monkeypatch.setattr("subprocess.run", fake_run)
+    house = tmp_path / "_shipped_wheels"
+    house.mkdir()
+    (house / "dep-1.0-py3-none-any.whl").write_bytes(b"v1")
+    _task_commons._install_shipped_wheels()
+    (house / "dep-1.0-py3-none-any.whl").write_bytes(b"v2")
+    _task_commons._install_shipped_wheels()
+    assert len(calls) == 2 and calls[0] != calls[1]
+    # Re-running with unchanged wheels hits the marker: no third install.
+    _task_commons._install_shipped_wheels()
+    assert len(calls) == 2

@@ -178,6 +178,23 @@ def choose_master(
     return addr
 
 
+def _wheelhouse_digest(house: str) -> str:
+    """Content digest of a shipped wheelhouse (sorted names + bytes),
+    keying the `_pydeps/<digest>` install target below."""
+    import hashlib
+
+    digest = hashlib.sha256()
+    for name in sorted(os.listdir(house)):
+        path = os.path.join(house, name)
+        if not os.path.isfile(path):
+            continue
+        digest.update(name.encode())
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                digest.update(chunk)
+    return digest.hexdigest()[:12]
+
+
 def _install_shipped_wheels() -> None:
     """File-channel third-party deps: a `_shipped_wheels/` dir in the
     task workdir (packaging.ship_files with requirements=) is
@@ -194,7 +211,14 @@ def _install_shipped_wheels() -> None:
     house = os.path.abspath("_shipped_wheels")
     if not os.path.isdir(house):
         return
-    target = os.path.abspath("_pydeps")
+    # Content-addressed install dir, mirroring ship_env's digest-keyed
+    # unpack root: a reused workdir whose _shipped_wheels/ changed gets a
+    # fresh _pydeps/<digest> and a fresh install — the marker can never
+    # vouch for a stale dep set (and removed dists can't linger in the
+    # target, as they would under pip --target into a shared dir).
+    target = os.path.abspath(
+        os.path.join("_pydeps", _wheelhouse_digest(house))
+    )
     marker = os.path.join(target, ".tpu_yarn_done")
     if not os.path.exists(marker):
         subprocess.run(

@@ -1,0 +1,30 @@
+"""JAX/TPU-aware static analysis for the tf_yarn_tpu codebase.
+
+The reference tf-yarn delegated data-plane correctness to Horovod/NCCL;
+this rewrite hand-rolls its collectives and shard_map plumbing
+(`tf_yarn_tpu/parallel/`), so axis-name typos, host side effects inside
+`jit`, and accidental host<->device transfers are *our* bug classes —
+exactly the failure modes TF-Replicator (arXiv:1902.00465) and Horovod
+(arXiv:1802.05799) moved into framework-verified code. Two engines make
+the growing `ops/`, `parallel/`, and `training.py` surface self-policing:
+
+* **AST lint engine** (`ast_engine`) — rule registry + visitor framework
+  with JAX-specific rules (TYA0xx): side effects inside `@jax.jit`/
+  `shard_map` bodies, host numpy on traced values, collective
+  `axis_name` literals that no mesh declares, traced-truthiness
+  hazards, missing `donate_argnums` on train-step jits, bare `except`.
+* **jaxpr engine** (`jaxpr_engine`) — abstractly traces exported entry
+  points (ops kernels, `parallel` collective wrappers, the model
+  fwd/bwd) and verifies collective axis names against the axes they run
+  under, flags host callbacks / `device_put` in hot paths (TYA1xx), and
+  reports per-function primitive counts so lowering regressions are
+  visible in review.
+
+Run it: ``python -m tf_yarn_tpu.analysis [paths...]`` (text or
+``--json``; suppress per line with ``# noqa: TYA0xx``). The repo gates
+itself on a clean run in ``tests/test_analysis.py``. Rule catalog and
+usage: ``docs/StaticAnalysis.md``.
+"""
+
+from tf_yarn_tpu.analysis.findings import Finding  # noqa: F401
+from tf_yarn_tpu.analysis.rules import RULES, Rule  # noqa: F401

@@ -1,0 +1,489 @@
+"""AST lint engine: JAX-specific rules over the analyzed source tree.
+
+Two passes. Pass 1 walks every module once to collect the *declared*
+mesh axis names (``AXIS_* = "dp"`` constants, string tuples handed to
+``Mesh(...)``, ``axis_names`` property returns, literal defaults of
+``axis``/``axis_name`` parameters) — the vocabulary TYA006 checks
+collective/PartitionSpec literals against. Pass 2 lints each module:
+a visitor tracks whether the current function body is *jit context*
+(decorated with ``jax.jit``/``shard_map``/``functools.partial(jax.jit,
+...)``, or passed by name to ``jax.jit(...)``/``shard_map(...)``
+anywhere in the module) and applies the trace-hazard rules there;
+module-wide rules (axis literals, donate_argnums, bare except) apply
+everywhere.
+
+Deliberately conservative: every rule keys on resolved dotted names
+(import aliases are followed, so ``from jax import lax; lax.psum`` and
+``jax.lax.psum`` both match) and flags only patterns that are wrong with
+high confidence — a lint the repo itself cannot pass is a lint that gets
+suppressed wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tf_yarn_tpu.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    noqa_lines,
+)
+
+# Collectives whose axis-name argument sits at position 1 (after the
+# operand), plus this repo's thin wrappers with the same signature.
+_COLLECTIVES_ARG1 = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "ppermute", "all_to_all",
+    "all_reduce_mean", "all_reduce_sum", "reduce_scatter", "ring_shift",
+}
+_COLLECTIVES_ARG0 = {"axis_index"}
+
+_TIME_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_NUMPY_ALLOW = {
+    # dtype/metadata accessors are trace-safe (and pervasive as literals)
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "dtype", "finfo",
+    "iinfo", "shape", "ndim", "result_type", "promote_types",
+}
+_HOST_RNG_METHODS_PREFIXES = ("random.", "numpy.random.")
+_DEVICE_TRANSFER_CALLS = {"jax.device_put", "jax.device_get"}
+_DEVICE_TRANSFER_METHODS = {"block_until_ready", "item", "tolist"}
+_TRAIN_STEP_NAME = re.compile(r"train_?step|update_?step|^step_fn")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local name -> dotted module/object path, from imports."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = (
+                    f"{node.module}.{alias.name}"
+                )
+    return aliases
+
+
+def _resolve(dotted: Optional[str], aliases: Dict[str, str]) -> Optional[str]:
+    if dotted is None:
+        return None
+    root, _, rest = dotted.partition(".")
+    base = aliases.get(root, root)
+    return f"{base}.{rest}" if rest else base
+
+
+def _is_jax_jit(resolved: Optional[str]) -> bool:
+    return resolved in ("jax.jit", "jit", "jax.pjit", "pjit",
+                        "jax.experimental.pjit.pjit")
+
+
+def _is_shard_map(resolved: Optional[str]) -> bool:
+    return resolved is not None and (
+        resolved.endswith("shard_map") or resolved == "smap"
+    )
+
+
+def _is_partial(resolved: Optional[str]) -> bool:
+    return resolved in ("functools.partial", "partial")
+
+
+def _string_literals(node: ast.AST) -> Optional[Set[str]]:
+    """Literal axis names in `node`: a str constant or a tuple/list of
+    them. None when the expression is not fully literal (variables are
+    someone else's declaration to check)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for element in node.elts:
+            if isinstance(element, ast.Constant) and isinstance(
+                element.value, str
+            ):
+                out.add(element.value)
+            else:
+                return None
+        return out
+    return None
+
+
+# --------------------------------------------------------------------------
+# Pass 1: declared axis names
+# --------------------------------------------------------------------------
+
+def collect_declared_axes(trees: Iterable[ast.Module]) -> Set[str]:
+    declared: Set[str] = set()
+    for tree in trees:
+        aliases = _collect_aliases(tree)
+        for node in ast.walk(tree):
+            # AXIS_FOO = "foo" module constants
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if (
+                        isinstance(target, ast.Name)
+                        and target.id.startswith("AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                    ):
+                        declared.add(node.value.value)
+            # Mesh(devices, ("dp", "tp")) / Mesh(..., axis_names=(...))
+            elif isinstance(node, ast.Call):
+                resolved = _resolve(_dotted(node.func), aliases) or ""
+                if resolved.endswith("Mesh"):
+                    candidates = list(node.args[1:2]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "axis_names"
+                    ]
+                    for candidate in candidates:
+                        declared |= _string_literals(candidate) or set()
+            # def f(..., axis="x"): a literal default is a declaration
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                positional = args.posonlyargs + args.args
+                for arg, default in zip(
+                    positional[len(positional) - len(args.defaults):],
+                    args.defaults,
+                ):
+                    if arg.arg in ("axis", "axis_name", "axis_names"):
+                        declared |= _string_literals(default) or set()
+                for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                    if default is not None and arg.arg in (
+                        "axis", "axis_name", "axis_names"
+                    ):
+                        declared |= _string_literals(default) or set()
+                # `def axis_names(self): return ("pp", ...)` properties
+                if node.name == "axis_names":
+                    for stmt in ast.walk(node):
+                        if isinstance(stmt, ast.Return) and stmt.value:
+                            declared |= _string_literals(stmt.value) or set()
+    return declared
+
+
+# --------------------------------------------------------------------------
+# Pass 2: per-module lint
+# --------------------------------------------------------------------------
+
+def _jitted_function_names(tree: ast.Module, aliases: Dict[str, str]) -> Set[str]:
+    """Names of module/local functions that end up under jit/shard_map via
+    a *call site*: `jax.jit(f)`, `shard_map(f, ...)`,
+    `shard_map(partial(f, ...), ...)`."""
+    jitted: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        resolved = _resolve(_dotted(node.func), aliases)
+        if not (_is_jax_jit(resolved) or _is_shard_map(resolved)):
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Call) and _is_partial(
+            _resolve(_dotted(target.func), aliases)
+        ) and target.args:
+            target = target.args[0]
+        name = _dotted(target)
+        if name and "." not in name:
+            jitted.add(name)
+    return jitted
+
+
+def _has_jit_decorator(
+    node: ast.FunctionDef, aliases: Dict[str, str]
+) -> bool:
+    for decorator in node.decorator_list:
+        if isinstance(decorator, ast.Call):
+            resolved = _resolve(_dotted(decorator.func), aliases)
+            if _is_jax_jit(resolved) or _is_shard_map(resolved):
+                return True
+            if _is_partial(resolved) and decorator.args:
+                inner = _resolve(_dotted(decorator.args[0]), aliases)
+                if _is_jax_jit(inner) or _is_shard_map(inner):
+                    return True
+        else:
+            resolved = _resolve(_dotted(decorator), aliases)
+            if _is_jax_jit(resolved) or _is_shard_map(resolved):
+                return True
+    return False
+
+
+def _contains_jnp_call(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            resolved = _resolve(_dotted(sub.func), aliases) or ""
+            if resolved.startswith(("jax.numpy.", "jnp.")) or resolved.startswith(
+                "jax.nn."
+            ):
+                return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module, declared_axes: Set[str]):
+        self.path = path
+        self.aliases = _collect_aliases(tree)
+        self.declared_axes = declared_axes
+        self.jitted_names = _jitted_function_names(tree, self.aliases)
+        self.findings: List[Finding] = []
+        self._jit_depth = 0
+
+    # -- helpers ----------------------------------------------------------
+    def _add(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(code, message, self.path,
+                    getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        )
+
+    @property
+    def _in_jit(self) -> bool:
+        return self._jit_depth > 0
+
+    # -- function context --------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        entered = (
+            _has_jit_decorator(node, self.aliases)
+            or node.name in self.jitted_names
+        )
+        self._jit_depth += 1 if (entered or self._in_jit) else 0
+        track = entered or self._jit_depth > 0
+        self.generic_visit(node)
+        if track and self._jit_depth:
+            self._jit_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- module-wide rules -------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._add(
+                node, "TYA008",
+                "bare `except:` catches KeyboardInterrupt/SystemExit; "
+                "use `except Exception` (or narrower)",
+            )
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        if self._in_jit:
+            self._add(
+                node, "TYA004",
+                f"global mutation of {', '.join(node.names)} inside a jit "
+                "body happens once at trace time, not per step",
+            )
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        if self._in_jit:
+            self._add(
+                node, "TYA004",
+                f"nonlocal mutation of {', '.join(node.names)} inside a jit "
+                "body happens once at trace time, not per step",
+            )
+
+    def _check_truthiness(self, node: ast.AST, test: ast.AST) -> None:
+        if self._in_jit and _contains_jnp_call(test, self.aliases):
+            self._add(
+                node, "TYA005",
+                "Python truthiness of a jnp expression inside a jit body "
+                "raises ConcretizationTypeError; use jnp.where / lax.cond",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truthiness(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truthiness(node, node.test)
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_truthiness(node, node.test)
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        resolved = _resolve(_dotted(node.func), self.aliases) or ""
+        leaf = resolved.rsplit(".", 1)[-1]
+
+        self._check_axis_literals(node, resolved, leaf)
+        self._check_train_step_jit(node, resolved)
+
+        if self._in_jit:
+            self._check_jit_hazards(node, resolved, leaf)
+        self.generic_visit(node)
+
+    def _check_axis_literals(
+        self, node: ast.Call, resolved: str, leaf: str
+    ) -> None:
+        # Collective axis-name literal vocabulary check (TYA006).
+        axis_nodes: List[ast.AST] = []
+        if leaf in _COLLECTIVES_ARG1:
+            if len(node.args) > 1:
+                axis_nodes.append(node.args[1])
+        elif leaf in _COLLECTIVES_ARG0:
+            if node.args:
+                axis_nodes.append(node.args[0])
+        if leaf in _COLLECTIVES_ARG1 | _COLLECTIVES_ARG0:
+            axis_nodes.extend(
+                kw.value for kw in node.keywords if kw.arg == "axis_name"
+            )
+        # PartitionSpec("dp", ...) entries share the same vocabulary.
+        if leaf == "PartitionSpec" or resolved.endswith(
+            "sharding.PartitionSpec"
+        ):
+            axis_nodes.extend(node.args)
+        for axis_node in axis_nodes:
+            literals = _string_literals(axis_node)
+            if not literals:
+                continue
+            unknown = literals - self.declared_axes
+            for name in sorted(unknown):
+                self._add(
+                    axis_node, "TYA006",
+                    f"axis name {name!r} is not declared by any Mesh/"
+                    f"MeshSpec/AXIS_* in the analyzed tree "
+                    f"(declared: {sorted(self.declared_axes) or 'none'})",
+                )
+
+    def _check_train_step_jit(self, node: ast.Call, resolved: str) -> None:
+        if not _is_jax_jit(resolved) or not node.args:
+            return
+        target = _dotted(node.args[0])
+        if not target or "." in target:
+            return
+        if not _TRAIN_STEP_NAME.search(target):
+            return
+        kwargs = {kw.arg for kw in node.keywords}
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            self._add(
+                node, "TYA007",
+                f"jax.jit({target}) threads train state without "
+                "donate_argnums: old and new optimizer state coexist in "
+                "HBM across the update",
+            )
+
+    def _check_jit_hazards(
+        self, node: ast.Call, resolved: str, leaf: str
+    ) -> None:
+        # TYA010 first: np.random.* is host RNG, not host numpy compute.
+        if resolved.startswith(_HOST_RNG_METHODS_PREFIXES):
+            self._add(
+                node, "TYA010",
+                f"host RNG `{resolved}` inside a jit body freezes one "
+                "sample into the compiled program; use jax.random",
+            )
+            return
+        if resolved in ("print", "builtins.print", "input", "open",
+                        "builtins.open"):
+            self._add(
+                node, "TYA001",
+                f"`{resolved}` inside a jit body runs at trace time only "
+                "(use jax.debug.print for per-step output)",
+            )
+            return
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            _LOG_METHODS
+        ):
+            owner = _dotted(node.func.value) or ""
+            if owner.rstrip("_").endswith("logger") or owner == "logging":
+                self._add(
+                    node, "TYA001",
+                    f"logging call `{owner}.{node.func.attr}` inside a jit "
+                    "body runs at trace time only",
+                )
+                return
+        if resolved in _TIME_CALLS:
+            self._add(
+                node, "TYA002",
+                f"`{resolved}()` inside a jit body measures trace time, "
+                "not device time",
+            )
+            return
+        if resolved in _DEVICE_TRANSFER_CALLS or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _DEVICE_TRANSFER_METHODS
+            and not node.args
+        ):
+            self._add(
+                node, "TYA009",
+                "device transfer / host sync inside a jit body "
+                "(device_put/device_get/block_until_ready/item) is a "
+                "no-op or trace hazard; move it outside the jit",
+            )
+            return
+        if resolved.startswith("numpy.") and leaf not in _NUMPY_ALLOW:
+            self._add(
+                node, "TYA003",
+                f"host numpy call `{resolved}` inside a jit body "
+                "concretizes traced values (or constant-folds at trace "
+                "time); use jnp",
+            )
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+def discover_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        else:
+            raise FileNotFoundError(path)
+    return files
+
+
+def analyze_paths(
+    paths: Sequence[str], extra_axes: Iterable[str] = ()
+) -> List[Finding]:
+    """Lint every .py under `paths`; returns suppression-filtered findings."""
+    files = discover_files(paths)
+    parsed: List[Tuple[str, str, ast.Module]] = []
+    findings: List[Finding] = []
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            parsed.append((path, source, ast.parse(source, filename=path)))
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding("TYA000", f"could not parse: {exc}", path)
+            )
+    declared = collect_declared_axes(tree for _, _, tree in parsed)
+    declared |= set(extra_axes)
+    for path, source, tree in parsed:
+        linter = _Linter(path, tree, declared)
+        linter.visit(tree)
+        findings.extend(
+            apply_suppressions(linter.findings, noqa_lines(source))
+        )
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code))

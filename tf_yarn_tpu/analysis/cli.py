@@ -1,0 +1,130 @@
+"""`python -m tf_yarn_tpu.analysis` — run both engines, report, gate.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error — so CI can gate
+on it directly (tests/test_analysis.py runs it over `tf_yarn_tpu/` in
+the tier-1 suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tf_yarn_tpu.analysis.findings import Finding
+from tf_yarn_tpu.analysis.rules import RULES
+
+
+def _parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_yarn_tpu.analysis",
+        description="JAX/TPU-aware static checker: AST lints (TYA0xx) + "
+        "jaxpr-level collective/axis verification (TYA1xx). "
+        "Rule catalog: docs/StaticAnalysis.md.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["tf_yarn_tpu"],
+        help="files/directories to lint (default: tf_yarn_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output (findings + primitive counts)",
+    )
+    parser.add_argument(
+        "--no-ast", action="store_true", help="skip the AST lint engine"
+    )
+    parser.add_argument(
+        "--no-jaxpr", action="store_true",
+        help="skip the jaxpr engine (entry-point tracing)",
+    )
+    parser.add_argument(
+        "--counts", action="store_true",
+        help="print per-entry-point primitive counts (text mode; always "
+        "present in --json)",
+    )
+    parser.add_argument(
+        "--axes", default="",
+        help="comma-separated extra declared axis names for TYA006 "
+        "(beyond what the analyzed tree itself declares)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    return parser
+
+
+def _force_cpu() -> None:
+    """The checker is a host-side tool: it must never dial a TPU relay
+    (the axon image pre-imports jax pointed at one; a wedged relay hangs
+    device init past any budget). Tracing needs no devices at all —
+    narrow jax to the CPU platform exactly like tests/conftest.py does."""
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.code}  [{rule.engine:>5}]  {rule.name}: "
+                  f"{rule.summary}")
+        return 0
+
+    findings: List[Finding] = []
+    counts = {}
+    extra_axes = [a.strip() for a in args.axes.split(",") if a.strip()]
+
+    if not args.no_ast:
+        from tf_yarn_tpu.analysis.ast_engine import analyze_paths
+
+        try:
+            findings.extend(analyze_paths(args.paths, extra_axes=extra_axes))
+        except FileNotFoundError as exc:
+            print(f"error: no such path: {exc}", file=sys.stderr)
+            return 2
+
+    skipped: List[str] = []
+    if not args.no_jaxpr:
+        _force_cpu()
+        from tf_yarn_tpu.analysis.jaxpr_engine import run as run_jaxpr
+
+        jaxpr_findings, counts, skipped = run_jaxpr()
+        findings.extend(jaxpr_findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "primitive_counts": counts,
+            "skipped_entries": skipped,
+            "n_findings": len(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        for notice in skipped:
+            print(f"skipped (environment): {notice}", file=sys.stderr)
+        for finding in findings:
+            print(finding.format())
+        if args.counts and counts:
+            print("\nper-entry primitive counts:")
+            for name in sorted(counts):
+                total = sum(counts[name].values())
+                top = sorted(
+                    counts[name].items(), key=lambda kv: -kv[1]
+                )[:8]
+                summary = ", ".join(f"{k}={v}" for k, v in top)
+                print(f"  {name}: {total} eqns ({summary})")
+        print(
+            f"{'no findings' if not findings else f'{len(findings)} finding(s)'}"
+            f" ({'ast' if not args.no_ast else ''}"
+            f"{'+' if not args.no_ast and not args.no_jaxpr else ''}"
+            f"{'jaxpr' if not args.no_jaxpr else ''} engines)"
+        )
+    return 1 if findings else 0

@@ -1,0 +1,71 @@
+"""Finding record + per-line `# noqa: TYA0xx` suppression.
+
+One shape serves both engines: AST findings carry a real (path, line);
+jaxpr findings anchor to the entry point's module file with line 0 (the
+defect is a property of the traced program, not one source line).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Sequence, Set
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?::\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?",
+    re.IGNORECASE,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    code: str
+    message: str
+    path: str
+    line: int = 0
+    col: int = 0
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def noqa_lines(source: str) -> Dict[int, Set[str]]:
+    """{line -> suppressed codes} from `# noqa` comments; the empty set
+    means a blanket `# noqa` (suppresses every code on that line).
+
+    Tokenized, not regexed over raw lines: a `# noqa` inside a string
+    literal must not suppress anything.
+    """
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _NOQA_RE.search(tok.string)
+            if not match:
+                continue
+            codes = match.group("codes")
+            out[tok.start[0]] = (
+                {c.strip().upper() for c in codes.split(",")} if codes else set()
+            )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def apply_suppressions(
+    findings: Sequence[Finding], suppressed: Dict[int, Set[str]]
+) -> List[Finding]:
+    kept = []
+    for finding in findings:
+        codes = suppressed.get(finding.line)
+        if codes is not None and (not codes or finding.code in codes):
+            continue
+        kept.append(finding)
+    return kept

@@ -1,0 +1,414 @@
+"""jaxpr engine: abstract-trace exported entry points and verify them.
+
+Where the AST engine reads *source*, this engine reads the *program*:
+each registered entry point is traced with `jax.make_jaxpr` over
+`ShapeDtypeStruct` inputs (no devices touched, no FLOPs spent — safe on
+a laptop and in CI) and the resulting jaxpr is walked recursively:
+
+* a trace failure is itself a finding (TYA101) — the same exception
+  would otherwise first fire on hardware, at step 0;
+* every collective primitive's axis names must lie inside the axis
+  environment the entry point declares it runs under (TYA102) — the
+  jaxpr-level twin of the AST engine's literal check, and the one that
+  catches axes smuggled in through variables;
+* host-callback / device-transfer primitives in hot paths are flagged
+  (TYA103) — a `jax.debug.print` left in a kernel is a host round-trip
+  per step;
+* per-entry primitive counts are reported, so a review diff that
+  silently doubles the `mul`s or drops a fused kernel's `custom_vjp`
+  shows up as a number.
+
+Entry points cover the surfaces ROADMAP cares about: the ops kernels,
+the `parallel.collectives` wrappers, ring/Ulysses attention bodies, and
+the flagship model's forward+backward.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tf_yarn_tpu.analysis.findings import Finding
+
+# Primitive names whose params carry mesh-axis names, and the param keys
+# they use (jax spells it 'axes' for reductions, 'axis_name' elsewhere).
+_AXIS_PARAM_KEYS = ("axes", "axis_name")
+_COLLECTIVE_PRIMITIVES = {
+    "psum", "pmin", "pmax", "ppermute", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "axis_index",
+}
+_HOST_CALLBACK_PRIMITIVES = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "device_put",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One abstractly-traceable surface.
+
+    `build` returns (fn, args_tuple, kwargs) — deferred so importing the
+    engine never imports jax-heavy modules. `axis_env` is the (name,
+    size) environment the trace runs under AND the vocabulary its
+    collectives are verified against; `expected_axes` narrows that
+    further when the entry should only ever touch a subset (ring
+    attention has no business reducing over `tp`). `hot` marks per-step
+    code where a host callback is a finding, not a curiosity.
+    `requires` names runtime capabilities (see `capabilities()`) the
+    entry needs: on an installation lacking them the entry is *skipped*
+    with a visible notice, not failed — the checker verifies this
+    codebase, not the host's jax build (the CPU test rig's jax predates
+    `Shardy` sharding rules; the TPU image does not).
+    """
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple, dict]]
+    axis_env: Tuple[Tuple[str, int], ...] = ()
+    expected_axes: Optional[Tuple[str, ...]] = None
+    hot: bool = True
+    requires: Tuple[str, ...] = ()
+
+
+def capabilities() -> frozenset:
+    """Runtime jax capabilities, probed once per process."""
+    global _CAPABILITIES
+    if _CAPABILITIES is not None:
+        return _CAPABILITIES
+    import inspect
+
+    import jax
+
+    caps = set()
+    if hasattr(jax, "shard_map"):
+        caps.add("jax.shard_map")
+    try:
+        from jax.experimental.custom_partitioning import (
+            custom_partitioning,
+        )
+
+        if "sharding_rule" in inspect.signature(
+            custom_partitioning.def_partition
+        ).parameters:
+            caps.add("custom_partitioning.sharding_rule")
+    except ImportError:
+        pass
+    _CAPABILITIES = frozenset(caps)
+    return _CAPABILITIES
+
+
+_CAPABILITIES: Optional[frozenset] = None
+
+
+def _walk_jaxpr(jaxpr) -> Iterable:
+    """Yield every eqn in `jaxpr` and all nested jaxprs (cond branches,
+    scan/while bodies, pjit/shard_map calls, custom_vjp closures)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for value in eqn.params.values():
+            for sub in _nested_jaxprs(value):
+                yield from _walk_jaxpr(sub)
+
+
+def _nested_jaxprs(value) -> Iterable:
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    if isinstance(value, ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, Jaxpr):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for item in value:
+            yield from _nested_jaxprs(item)
+
+
+def _axis_names(eqn) -> List[str]:
+    names: List[str] = []
+    for key in _AXIS_PARAM_KEYS:
+        value = eqn.params.get(key)
+        if value is None:
+            continue
+        if isinstance(value, (tuple, list)):
+            names.extend(v for v in value if isinstance(v, str))
+        elif isinstance(value, str):
+            names.append(value)
+    return names
+
+
+def check_entry(entry: EntryPoint) -> Tuple[List[Finding], Dict[str, int]]:
+    """Trace one entry point; returns (findings, primitive counts)."""
+    import jax
+
+    findings: List[Finding] = []
+    counts: collections.Counter = collections.Counter()
+    try:
+        fn, args, kwargs = entry.build()
+        closed = jax.make_jaxpr(
+            lambda *a: fn(*a, **kwargs), axis_env=list(entry.axis_env)
+        )(*args)
+    except Exception as exc:  # the finding IS the failure
+        findings.append(
+            Finding(
+                "TYA101",
+                f"entry point `{entry.name}` failed to trace: "
+                f"{type(exc).__name__}: {exc}",
+                entry.name,
+            )
+        )
+        return findings, {}
+
+    allowed = {name for name, _ in entry.axis_env}
+    expected = (
+        set(entry.expected_axes) if entry.expected_axes is not None else None
+    )
+    for eqn in _walk_jaxpr(closed.jaxpr):
+        prim = eqn.primitive.name
+        counts[prim] += 1
+        if prim in _COLLECTIVE_PRIMITIVES:
+            for axis in _axis_names(eqn):
+                if axis not in allowed:
+                    findings.append(
+                        Finding(
+                            "TYA102",
+                            f"`{entry.name}`: collective `{prim}` names "
+                            f"axis {axis!r}, outside its declared axis "
+                            f"environment {sorted(allowed)}",
+                            entry.name,
+                        )
+                    )
+                elif expected is not None and axis not in expected:
+                    findings.append(
+                        Finding(
+                            "TYA102",
+                            f"`{entry.name}`: collective `{prim}` names "
+                            f"axis {axis!r}, outside the axes this entry "
+                            f"is documented to use {sorted(expected)}",
+                            entry.name,
+                        )
+                    )
+        if entry.hot and prim in _HOST_CALLBACK_PRIMITIVES:
+            findings.append(
+                Finding(
+                    "TYA103",
+                    f"`{entry.name}`: host-callback/device-transfer "
+                    f"primitive `{prim}` in a hot path — a host "
+                    "round-trip per step",
+                    entry.name,
+                )
+            )
+    return findings, dict(counts)
+
+
+def run(
+    entries: Optional[Sequence[EntryPoint]] = None,
+) -> Tuple[List[Finding], Dict[str, Dict[str, int]], List[str]]:
+    """Check every entry; returns (findings, {entry: primitive counts},
+    skipped-entry notices)."""
+    if entries is None:
+        entries = default_entry_points()
+    findings: List[Finding] = []
+    all_counts: Dict[str, Dict[str, int]] = {}
+    skipped: List[str] = []
+    caps = capabilities()
+    for entry in entries:
+        missing = [r for r in entry.requires if r not in caps]
+        if missing:
+            skipped.append(
+                f"{entry.name}: this jax build lacks {', '.join(missing)}"
+            )
+            continue
+        entry_findings, counts = check_entry(entry)
+        findings.extend(entry_findings)
+        if counts:
+            all_counts[entry.name] = counts
+    return findings, all_counts, skipped
+
+
+# --------------------------------------------------------------------------
+# The repo's entry-point registry
+# --------------------------------------------------------------------------
+
+def _f32(*shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _ops_entries() -> List[EntryPoint]:
+    def attention_xla():
+        from tf_yarn_tpu.ops.attention import xla_attention
+
+        return (
+            lambda q, k, v: xla_attention(q, k, v, causal=True),
+            (_f32(2, 8, 4, 16), _f32(2, 8, 2, 16), _f32(2, 8, 2, 16)),
+            {},
+        )
+
+    def rmsnorm():
+        from tf_yarn_tpu.ops.rmsnorm import rmsnorm
+
+        # interpret=True: tracing must not require a TPU lowering path.
+        return (
+            lambda x, s: rmsnorm(x, s, interpret=True),
+            (_f32(8, 128), _f32(128)),
+            {},
+        )
+
+    def rmsnorm_grad():
+        import jax
+
+        from tf_yarn_tpu.ops.rmsnorm import rmsnorm
+
+        def loss(x, s):
+            return rmsnorm(x, s, interpret=True).sum()
+
+        return jax.grad(loss, argnums=(0, 1)), (_f32(8, 128), _f32(128)), {}
+
+    def layernorm():
+        from tf_yarn_tpu.ops.layernorm import layernorm
+
+        return (
+            lambda x, s, b: layernorm(x, s, b, interpret=True),
+            (_f32(8, 128), _f32(128), _f32(128)),
+            {},
+        )
+
+    def quantize():
+        from tf_yarn_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+        def roundtrip(x):
+            values, scales = quantize_int8(x, interpret=True)
+            return dequantize_int8(values, scales)
+
+        return roundtrip, (_f32(8, 128),), {}
+
+    # The fused norms partition via Shardy sharding rules
+    # (make_sharded_op); a jax build without them cannot even trace the
+    # custom_partitioning registration.
+    shardy = ("custom_partitioning.sharding_rule",)
+    return [
+        EntryPoint("ops.attention.xla_attention", attention_xla),
+        EntryPoint("ops.rmsnorm.rmsnorm", rmsnorm, requires=shardy),
+        EntryPoint("ops.rmsnorm.rmsnorm_grad", rmsnorm_grad, requires=shardy),
+        EntryPoint("ops.layernorm.layernorm", layernorm, requires=shardy),
+        EntryPoint("ops.quantize.int8_roundtrip", quantize),
+    ]
+
+
+def _collective_entries() -> List[EntryPoint]:
+    """The parallel.collectives wrappers, each traced under the canonical
+    mesh axes (parallel.mesh.MeshSpec) so a wrapper that hardcodes or
+    mangles an axis name fails TYA102 here, not on a pod."""
+    from tf_yarn_tpu.parallel import mesh as mesh_lib
+
+    axis_env = tuple(
+        (name, 2)
+        for name in (
+            mesh_lib.AXIS_DP, mesh_lib.AXIS_FSDP, mesh_lib.AXIS_TP,
+            mesh_lib.AXIS_SP, mesh_lib.AXIS_EP, mesh_lib.AXIS_PP,
+        )
+    )
+
+    def wrapper(fn_name: str, axis: str):
+        def build():
+            from tf_yarn_tpu.parallel import collectives
+
+            fn = getattr(collectives, fn_name)
+            return (lambda x: fn(x, axis)), (_f32(4, 8),), {}
+
+        return build
+
+    entries = []
+    for fn_name in ("all_reduce_mean", "all_reduce_sum", "reduce_scatter",
+                    "all_gather", "ring_shift"):
+        entries.append(
+            EntryPoint(
+                f"parallel.collectives.{fn_name}",
+                wrapper(fn_name, mesh_lib.AXIS_DP),
+                axis_env=axis_env,
+                expected_axes=(mesh_lib.AXIS_DP,),
+            )
+        )
+    return entries
+
+
+def _parallel_entries() -> List[EntryPoint]:
+    from tf_yarn_tpu.parallel import mesh as mesh_lib
+
+    sp_env = ((mesh_lib.AXIS_SP, 2),)
+
+    def ring():
+        from tf_yarn_tpu.parallel.ring_attention import ring_attention
+
+        return (
+            lambda q, k, v: ring_attention(q, k, v, causal=True),
+            (_f32(2, 8, 4, 16), _f32(2, 8, 2, 16), _f32(2, 8, 2, 16)),
+            {},
+        )
+
+    def ulysses():
+        from tf_yarn_tpu.parallel.ulysses import ulysses_attention
+
+        return (
+            lambda q, k, v: ulysses_attention(q, k, v, causal=True),
+            (_f32(2, 8, 4, 16), _f32(2, 8, 2, 16), _f32(2, 8, 2, 16)),
+            {},
+        )
+
+    return [
+        EntryPoint(
+            "parallel.ring_attention.ring_attention", ring,
+            axis_env=sp_env, expected_axes=(mesh_lib.AXIS_SP,),
+        ),
+        EntryPoint(
+            "parallel.ulysses.ulysses_attention", ulysses,
+            axis_env=sp_env, expected_axes=(mesh_lib.AXIS_SP,),
+        ),
+    ]
+
+
+def _model_entries() -> List[EntryPoint]:
+    def transformer_fwd_bwd():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models import common
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+        config = TransformerConfig.tiny()
+        model = Transformer(config)
+        tokens = jax.ShapeDtypeStruct((2, 16), jnp.int32)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        params = sharding_lib.unbox_params(
+            jax.eval_shape(lambda r, t: model.init(r, t), rng, tokens)
+        )
+
+        def loss_and_grad(params, tokens, rng):
+            def loss(p):
+                value, _aux = common.lm_loss(
+                    model, p, {"tokens": tokens}, rng, train=False
+                )
+                return value
+
+            return jax.value_and_grad(loss)(params)
+
+        return loss_and_grad, (params, tokens, rng), {}
+
+    return [
+        EntryPoint("models.transformer.fwd_bwd", transformer_fwd_bwd),
+    ]
+
+
+def default_entry_points() -> List[EntryPoint]:
+    return (
+        _ops_entries()
+        + _collective_entries()
+        + _parallel_entries()
+        + _model_entries()
+    )

@@ -1,0 +1,100 @@
+"""The TYA rule catalog: one registry both engines and the docs draw on.
+
+TYA0xx are AST lints (ast_engine), TYA1xx are jaxpr-level verifications
+(jaxpr_engine). `docs/StaticAnalysis.md` renders this table; keep the
+summaries one line so `--list-rules` stays scannable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    engine: str  # "ast" | "jaxpr"
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _register(code: str, name: str, summary: str, engine: str) -> None:
+    RULES[code] = Rule(code, name, summary, engine)
+
+
+# --- AST lints -----------------------------------------------------------
+_register(
+    "TYA001", "host-side-effect-in-jit",
+    "side-effecting call (print/input/open/logging) inside a jit/shard_map "
+    "body runs at trace time only, silently, not per step", "ast",
+)
+_register(
+    "TYA002", "host-timing-in-jit",
+    "time.time()/perf_counter()/sleep() inside a jit body measures trace "
+    "time, not device time (use jax.block_until_ready outside)", "ast",
+)
+_register(
+    "TYA003", "host-numpy-on-traced",
+    "np.* computation inside a jit body concretizes traced values (or "
+    "constant-folds at trace time); use jnp", "ast",
+)
+_register(
+    "TYA004", "nonlocal-mutation-in-jit",
+    "assigning a global/nonlocal inside a jit body happens once at trace "
+    "time, not per step", "ast",
+)
+_register(
+    "TYA005", "traced-truthiness",
+    "Python if/while/assert/bool() on a jnp expression inside a jit body "
+    "raises ConcretizationTypeError (or silently freezes a trace-time "
+    "branch)", "ast",
+)
+_register(
+    "TYA006", "undeclared-axis-name",
+    "collective/PartitionSpec axis-name literal that no Mesh/MeshSpec/"
+    "AXIS_* declaration in the analyzed tree defines — a typo XLA only "
+    "reports at trace time, on hardware", "ast",
+)
+_register(
+    "TYA007", "train-step-jit-missing-donate",
+    "jax.jit of a train-step function without donate_argnums doubles "
+    "peak HBM: old and new optimizer state coexist across the update",
+    "ast",
+)
+_register(
+    "TYA008", "bare-except",
+    "bare `except:` swallows KeyboardInterrupt/SystemExit around "
+    "checkpoint/fs I/O; catch Exception (or narrower)", "ast",
+)
+_register(
+    "TYA009", "device-transfer-in-jit",
+    "jax.device_put/device_get/.block_until_ready()/.item() inside a jit "
+    "body is a no-op or a trace-time hazard; transfers belong outside",
+    "ast",
+)
+_register(
+    "TYA010", "host-rng-in-jit",
+    "random.*/np.random.* inside a jit body freezes one sample into the "
+    "compiled program; use jax.random with a threaded key", "ast",
+)
+
+# --- jaxpr verifications -------------------------------------------------
+_register(
+    "TYA101", "entry-point-trace-failure",
+    "a registered entry point failed to trace abstractly (the same error "
+    "would surface at first real call, on hardware)", "jaxpr",
+)
+_register(
+    "TYA102", "collective-axis-mismatch",
+    "a collective in the traced jaxpr names an axis outside the axis "
+    "environment the entry point declares it runs under", "jaxpr",
+)
+_register(
+    "TYA103", "host-callback-in-hot-path",
+    "device_put / pure_callback / io_callback / debug_callback primitive "
+    "in a hot-path jaxpr: a host round-trip per step", "jaxpr",
+)

@@ -65,7 +65,15 @@ def xla_attention(
         keep = key_padding_mask.astype(bool)[:, None, None, :]  # [B,1,1,Skv]
         logits = jnp.where(keep, logits, neg_inf)
     probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+    if key_padding_mask is not None:
+        # A fully-padded row (no real keys) would otherwise get a
+        # silent uniform softmax over finfo.min logits — finite garbage.
+        # Zero those rows' outputs instead: [B,1,1,1] broadcast over
+        # out's [B,S,H,D].
+        has_any_key = jnp.any(keep, axis=-1)[..., None]
+        out = jnp.where(has_any_key, out, jnp.zeros((), out.dtype))
+    return out
 
 
 def attention(query, key, value, *, impl: str = "xla", causal: bool = True,

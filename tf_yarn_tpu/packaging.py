@@ -300,6 +300,16 @@ def build_wheelhouse(
 
     if requirements is None and wheels_dir is None:
         raise ValueError("need requirements specs and/or a wheels_dir")
+    if isinstance(requirements, str) and not os.path.exists(requirements):
+        # A lone spec string ("numpy==1.26") is the natural mis-call of
+        # the list-vs-path contract; getmtime's FileNotFoundError names
+        # neither the contract nor the fix.
+        raise ValueError(
+            f"requirements={requirements!r}: a string is the PATH to a "
+            "requirements.txt, and no such file exists. Pass pip specs "
+            f"as a list (requirements=[{requirements!r}]) or point to "
+            "an existing requirements file."
+        )
     key = _wheelhouse_cache_key(
         requirements, wheels_dir, platform, python_version)
     cached = _WHEELHOUSE_CACHE.get(key)

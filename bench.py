@@ -343,7 +343,10 @@ def _stale_tpu_fields() -> dict:
         ),
     }
     decode = table.get("decode") or {}
-    for key in ("decode_tokens_per_sec_bf16", "decode_tokens_per_sec_int8"):
+    for key in ("decode_tokens_per_sec_bf16", "decode_tokens_per_sec_int8",
+                "engine_tokens_per_sec_bf16", "engine_tokens_per_sec_int8",
+                "percall_jit_tokens_per_sec_bf16",
+                "percall_jit_tokens_per_sec_int8"):
         if key in decode:
             fields[f"last_tpu_{key}"] = decode[key]
     longctx = table.get("long_context") or {}
@@ -573,6 +576,14 @@ def bench_flagship_train():
                 "decode_tokens_per_sec_bf16"]
             result["decode_tokens_per_sec_int8"] = decode[
                 "decode_tokens_per_sec_int8"]
+            # Serving-path A/B (DecodeEngine vs per-call jit), when the
+            # suite produced it.
+            for key in ("engine_tokens_per_sec_bf16",
+                        "engine_tokens_per_sec_int8",
+                        "percall_jit_tokens_per_sec_bf16",
+                        "percall_jit_tokens_per_sec_int8"):
+                if key in decode:
+                    result[key] = decode[key]
             _log(f"decode: {decode}")
         except Exception as exc:
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")

@@ -425,13 +425,23 @@ def bench_decode(tpu: bool):
     """Autoregressive decode throughput (tokens/sec), bf16 vs int8 KV
     cache. Decode steps are scanned inside ONE jitted program — per-step
     host dispatch (~5ms through a relay) would otherwise dominate the
-    ~ms-scale decode step and measure the wrong thing."""
+    ~ms-scale decode step and measure the wrong thing.
+
+    The `engine` vs `percall_jit` pair A/Bs the serving path itself:
+    `DecodeEngine` (compile cached across calls, on-device EOS loop,
+    donated cache) against the legacy `generate_legacy` host loop (fresh
+    jitted step closure per call + one host sync per token). Both time a
+    SECOND call end-to-end — exactly what a warm server pays per batch —
+    so the engine's cached compile and the legacy path's per-call
+    retrace are both visible in the number."""
     import time
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.generate import generate_legacy
     from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
     from tf_yarn_tpu.parallel.mesh import select_devices
 
@@ -497,6 +507,34 @@ def bench_decode(tpu: bool):
         results[f"decode_ms_per_step_{cache_dtype}"] = round(
             1000 * elapsed / decode_tokens, 3
         )
+
+        def _timed_call(fn):
+            # Warm call compiles (engine) / traces (per-call jit); sync
+            # it so no async tail leaks into the timed window.
+            int(jax.device_get(fn())[0, -1])
+            t0 = time.time()
+            out = fn()
+            int(jax.device_get(out)[0, -1])  # sync (relay-safe)
+            return batch * decode_tokens / (time.time() - t0)
+
+        try:
+            engine = DecodeEngine(model)
+            results[f"engine_tokens_per_sec_{cache_dtype}"] = round(
+                _timed_call(lambda: engine.generate(
+                    params, prompt, decode_tokens, temperature=0.0)), 2
+            )
+            results[f"engine_decode_compiles_{cache_dtype}"] = (
+                engine.stats["decode_compiles"]
+            )
+            results[f"percall_jit_tokens_per_sec_{cache_dtype}"] = round(
+                _timed_call(lambda: generate_legacy(
+                    model, params, prompt, decode_tokens,
+                    temperature=0.0)), 2
+            )
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            results[f"engine_error_{cache_dtype}"] = (
+                f"{type(exc).__name__}: {exc}"[:160]
+            )
     return {
         "batch": batch, "prefill": prefill_len,
         "decode_tokens": decode_tokens, **results,

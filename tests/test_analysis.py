@@ -203,6 +203,11 @@ def test_jaxpr_engine_default_entries_clean_on_this_build():
     # the flagship model traced: lowering regressions show as count diffs
     assert "models.transformer.fwd_bwd" in counts
     assert counts["models.transformer.fwd_bwd"]["dot_general"] > 0
+    # the serving path traced: the decode loop is a hot entry, so a host
+    # callback smuggled into it fails here, and the while_loop itself
+    # must be present (the on-device-EOS-loop contract).
+    assert "models.decode_engine.prefill" in counts
+    assert counts["models.decode_engine.decode_loop"]["while"] >= 1
 
 
 def test_finding_format_and_json_roundtrip():

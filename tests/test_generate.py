@@ -127,6 +127,32 @@ def test_generate_with_int8_kv_cache():
     assert (np.asarray(out) >= 0).all()
 
 
+def test_top_k_at_or_above_vocab_size_keeps_full_distribution():
+    """Regression: top_k >= vocab indexed `sorted_desc[:, top_k - 1]`
+    past the row's end. Clamped, it must be a no-op filter — identical
+    draws to unfiltered sampling under the same key."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.generate import _sample
+
+    logits = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    unfiltered = _sample(logits, key, temperature=1.0, top_k=None)
+    for top_k in (8, 9, 100):
+        draws = _sample(logits, key, temperature=1.0, top_k=top_k)
+        np.testing.assert_array_equal(np.asarray(draws),
+                                      np.asarray(unfiltered))
+    # And through generate(): top_k wider than the vocab must not crash.
+    model, params = _model_and_params(scan_layers=False)
+    out = generate(
+        model, params, jnp.zeros((1, 4), jnp.int32), max_new_tokens=3,
+        temperature=1.0, top_k=10_000,
+    )
+    assert out.shape == (1, 7)
+
+
 def test_top_p_sampling_stays_in_nucleus():
     """Nucleus sampling never emits a token outside the smallest prefix
     whose probability mass reaches top_p; the top token always stays

@@ -159,6 +159,122 @@ def test_inference_output_to_fs_uri(tmp_path):
         fs_lib.unregister_scheme("mockout")
 
 
+def _init_model(monkeypatch):
+    """A restorable model WITHOUT the training/checkpoint stack: init
+    params and patch `_restore_params` to hand them straight to
+    run_inference. The restore path itself is covered by the end-to-end
+    tests above; these tests target the decode pipeline."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu import inference as inference_mod
+
+    cfg = transformer.TransformerConfig.tiny(max_seq_len=32)
+    model = transformer.Transformer(cfg)
+    variables = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 5), jnp.int32))
+    )
+    monkeypatch.setattr(
+        inference_mod, "_restore_params", lambda model_dir, step: (variables, 1)
+    )
+    return model, variables
+
+
+def test_pipeline_end_to_end_and_engine_stats(tmp_path, monkeypatch):
+    """The three-stage pipeline (prefetch -> engine decode -> background
+    writer) must preserve record order across batches and surface the
+    decode-engine compile stats."""
+    model, _variables = _init_model(monkeypatch)
+    model_dir = str(tmp_path / "model")
+    out_path = str(tmp_path / "out.jsonl")
+
+    def stream():
+        rng = np.random.RandomState(0)
+        for start in range(4):
+            yield {
+                "tokens": rng.randint(0, 256, (2, 5)).astype(np.int32),
+                "id": np.arange(start * 2, start * 2 + 2),
+            }
+
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=stream,
+        output_path=out_path,
+        max_new_tokens=3,
+        temperature=0.0,
+        prefetch_depth=2,
+        writer_depth=1,  # exercise writer backpressure
+    )
+    stats = run_inference(experiment)
+    assert stats["records"] == 8
+    assert stats["batches"] == 4
+    # No eos configured: every generated token is real.
+    assert stats["tokens_per_sec"] == stats["padded_tokens_per_sec"]
+    # Same shape every batch: one compiled prefill + one decode program.
+    assert stats["decode_engine"]["decode_compiles"] >= 1
+    records = [json.loads(line) for line in open(out_path)]
+    assert [r["id"] for r in records] == list(range(8))
+    for record in records:
+        assert len(record["tokens"]) == 3
+
+
+def test_tokens_per_sec_excludes_eos_padding(tmp_path, monkeypatch):
+    """Regression: the repeated-eos fill after the early exit used to be
+    counted as generated tokens. Real throughput counts each row up to
+    its first eos; the padded figure stays available separately."""
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.models.generate import generate
+
+    model, variables = _init_model(monkeypatch)
+    model_dir = str(tmp_path / "model")
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    greedy = generate(model, variables, jnp.asarray(prompt), 6,
+                      temperature=0.0)
+    eos = int(greedy[0, 3])  # first generated token -> immediate finish
+
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=lambda: iter([{"tokens": prompt}]),
+        output_path=str(tmp_path / "out.jsonl"),
+        max_new_tokens=6,
+        temperature=0.0,
+        eos_token=eos,
+    )
+    stats = run_inference(experiment)
+    assert stats["records"] == 1
+    # 1 real token (the eos itself) vs 6 padded: same elapsed time, so
+    # the padded rate must be exactly 6x the real rate.
+    assert stats["padded_tokens_per_sec"] == pytest.approx(
+        6 * stats["tokens_per_sec"], rel=0.01
+    )
+    record = json.loads(open(str(tmp_path / "out.jsonl")).readline())
+    assert record["tokens"] == [eos] * 6
+
+
+def test_writer_error_propagates(tmp_path, monkeypatch):
+    """A failing input stream must not deadlock the bounded writer."""
+    model, _variables = _init_model(monkeypatch)
+    model_dir = str(tmp_path / "model")
+
+    def bad_stream():
+        yield {"tokens": np.zeros((1, 4), np.int32)}
+        raise RuntimeError("input stream died")
+
+    experiment = InferenceExperiment(
+        model=model,
+        model_dir=model_dir,
+        input_fn=bad_stream,
+        output_path=str(tmp_path / "out.jsonl"),
+        max_new_tokens=2,
+    )
+    with pytest.raises(RuntimeError, match="input stream died"):
+        run_inference(experiment)
+
+
 def test_run_inference_missing_checkpoint(tmp_path):
     cfg = transformer.TransformerConfig.tiny(max_seq_len=32)
     experiment = InferenceExperiment(

@@ -405,10 +405,74 @@ def _model_entries() -> List[EntryPoint]:
     ]
 
 
+def _decode_entries() -> List[EntryPoint]:
+    """The compiled decode engine's two programs (models/decode_engine.py):
+    the bucketed prefill and the on-device while_loop decode. Both are
+    hot — the decode loop runs once per generated token, so a host
+    callback or device transfer smuggled into either is exactly the
+    per-token round-trip the engine exists to eliminate."""
+
+    def _engine_avals():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import build_prefill_fn
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+        config = TransformerConfig.tiny()
+        model = Transformer(config)
+        prompt = jax.ShapeDtypeStruct((2, 8), jnp.int32)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        params = sharding_lib.unbox_params(
+            jax.eval_shape(lambda r, t: model.init(r, t), rng, prompt)
+        )
+        cache = jax.eval_shape(build_prefill_fn(model), params, prompt)[0]
+        return model, params, prompt, cache
+
+    def prefill():
+        from tf_yarn_tpu.models.decode_engine import build_prefill_fn
+
+        model, params, prompt, _cache = _engine_avals()
+        return build_prefill_fn(model), (params, prompt), {}
+
+    def decode_loop():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import build_decode_fn
+
+        model, params, _prompt, cache = _engine_avals()
+        fn = build_decode_fn(
+            model, temperature=0.0, top_k=None, top_p=None,
+            has_eos=True, has_rest=True,
+        )
+        scalar = jax.ShapeDtypeStruct((), jnp.int32)
+        args = (
+            params, cache,
+            jax.ShapeDtypeStruct((2, 8), jnp.int32),   # rest buffer
+            scalar,                                     # rest_len
+            scalar,                                     # num_new
+            jax.ShapeDtypeStruct((2,), jnp.uint32),     # rng
+            scalar,                                     # eos_id
+            jax.ShapeDtypeStruct((2, 16), jnp.int32),   # out buffer
+        )
+        return fn, args, {}
+
+    return [
+        EntryPoint("models.decode_engine.prefill", prefill),
+        EntryPoint("models.decode_engine.decode_loop", decode_loop),
+    ]
+
+
 def default_entry_points() -> List[EntryPoint]:
     return (
         _ops_entries()
         + _collective_entries()
         + _parallel_entries()
         + _model_entries()
+        + _decode_entries()
     )

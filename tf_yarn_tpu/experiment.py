@@ -259,6 +259,11 @@ class InferenceExperiment:
     # Multi-instance jobs whose input_fn ignores (shard, num_shards) fail
     # fast unless duplication of the full stream is explicitly intended.
     allow_duplicate_stream: bool = False
+    # Pipeline depths (inference.run_inference): input batches staged
+    # ahead of the device, and decoded batches queued to the background
+    # JSONL writer before the producer blocks.
+    prefetch_depth: int = 2
+    writer_depth: int = 8
 
 
 @dataclasses.dataclass

@@ -5,6 +5,15 @@ train → checkpoint → generate lifecycle on the same launcher, task
 programs and coordination the training path uses. No reference analog
 (tf-yarn launches training only).
 
+The loop is a three-stage pipeline so the device never idles on host
+I/O: `data.prefetch.prefetch` stages input batches ahead on a background
+thread, the compiled decode engine (`models.generate.generate` →
+`DecodeEngine`) generates, and a bounded background writer thread drains
+finished sequences to JSONL — the device_get that materializes each
+batch's tokens happens on the writer thread, overlapped with the next
+batch's decode (JAX async dispatch returns device futures to the main
+thread).
+
 Sharding across task instances is the input_fn's choice: declare
 ``(shard, num_shards)`` keywords to receive this task's slice of the
 stream; instance outputs are suffixed ``-<task_id>`` so they never
@@ -17,6 +26,8 @@ import inspect
 import io
 import json
 import logging
+import queue
+import threading
 import time
 from typing import Optional
 
@@ -80,9 +91,93 @@ def _restore_params(model_dir: str, step: Optional[int]):
     return params, step
 
 
+class _JsonlWriter:
+    """Bounded background JSONL writer (stage 3 of the pipeline).
+
+    The main thread enqueues (tokens, sequences, extras) with
+    `sequences` still a device array: the device_get that blocks on the
+    decode happens HERE, overlapped with the next batch's prefill/decode
+    on the main thread. The queue bound keeps finished batches from
+    piling up in HBM when the filesystem is slow; a dead writer never
+    deadlocks the producer (it drains without processing and the error
+    re-raises on the next `put`/`close`).
+
+    Also the token accountant: `real_tokens` counts each row's generated
+    tokens up to and including its first eos — the repeated-eos tail the
+    early-exit fill produces is *padding*, not generation — while
+    `padded_tokens` keeps the full-width figure.
+    """
+
+    def __init__(self, out, eos_token: Optional[int], depth: int):
+        self._out = out
+        self._eos = eos_token
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._exc: Optional[BaseException] = None
+        self.records = 0
+        self.real_tokens = 0
+        self.padded_tokens = 0
+        self._thread = threading.Thread(
+            target=self._run, name="inference-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _write_batch(self, tokens, sequences, extras) -> None:
+        sequences = np.asarray(sequences)  # blocks on the device here
+        tokens = np.asarray(tokens)
+        prompt_len = tokens.shape[1]
+        generated = sequences[:, prompt_len:]
+        for row in range(sequences.shape[0]):
+            record = {
+                "prompt": tokens[row].tolist(),
+                "tokens": generated[row].tolist(),
+            }
+            for key, value in extras.items():
+                record[key] = np.asarray(value[row]).tolist()
+            self._out.write(json.dumps(record) + "\n")
+            self.records += 1
+        self.padded_tokens += int(generated.size)
+        if self._eos is None:
+            self.real_tokens += int(generated.size)
+        else:
+            hit = generated == self._eos
+            # First eos per row counts (the model generated it); the
+            # repeated-eos fill after it does not. Rows with no eos are
+            # all real.
+            first = np.where(
+                hit.any(axis=1), hit.argmax(axis=1) + 1, generated.shape[1]
+            )
+            self.real_tokens += int(first.sum())
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._exc is not None:
+                continue  # drain so the producer never blocks
+            try:
+                self._write_batch(*item)
+            except BaseException as exc:  # noqa: BLE001 - re-raised in put/close
+                self._exc = exc
+
+    def put(self, tokens, sequences, extras) -> None:
+        if self._exc is not None:
+            raise self._exc
+        self._q.put((tokens, sequences, extras))
+
+    def close(self) -> None:
+        """Flush the queue, stop the thread, re-raise any writer error."""
+        self._q.put(None)
+        self._thread.join()
+        if self._exc is not None:
+            raise self._exc
+
+
 def run_inference(experiment, runtime=None) -> dict:
     """Generate for every batch of the (sharded) input stream; returns
-    summary stats ({"records", "batches", "tokens_per_sec"})."""
+    summary stats ({"records", "batches", "tokens_per_sec",
+    "padded_tokens_per_sec", ...})."""
+    from tf_yarn_tpu.data.prefetch import prefetch
     from tf_yarn_tpu.models.generate import generate
 
     shard, num_shards = 0, 1
@@ -104,49 +199,67 @@ def run_inference(experiment, runtime=None) -> dict:
     if num_shards > 1:
         out_path = f"{out_path}-{shard}"
 
-    records = batches = 0
-    new_tokens = 0
+    batches = 0
     t0 = time.time()
     # output_path may be any fs URI (gs://, hdfs://, ...) — results land
     # where the fleet can read them, like every other model_dir artifact.
     with io.TextIOWrapper(fs_lib.open_output(out_path), encoding="utf-8") as out:
-        for batch in _call_input_fn(experiment.input_fn, shard, num_shards):
-            tokens = np.asarray(batch["tokens"], np.int32)
-            sequences = generate(
-                experiment.model,
-                variables,
-                tokens,
-                max_new_tokens=experiment.max_new_tokens,
-                temperature=experiment.temperature,
-                top_k=experiment.top_k,
-                top_p=getattr(experiment, "top_p", None),
-                eos_token=experiment.eos_token,
+        writer = _JsonlWriter(
+            out, experiment.eos_token,
+            depth=getattr(experiment, "writer_depth", 8),
+        )
+        try:
+            # Stage 1: input batches staged ahead on a background thread;
+            # stage 2 (this thread): the compiled decode engine — generate
+            # returns an async device future, so the put below does not
+            # wait for the decode to finish.
+            stream = prefetch(
+                _call_input_fn(experiment.input_fn, shard, num_shards),
+                depth=getattr(experiment, "prefetch_depth", 2),
             )
-            sequences = np.asarray(sequences)
-            extras = {
-                key: np.asarray(value)
-                for key, value in batch.items()
-                if key != "tokens"
-            }
-            for row in range(sequences.shape[0]):
-                record = {
-                    "prompt": tokens[row].tolist(),
-                    "tokens": sequences[row, tokens.shape[1]:].tolist(),
+            for batch in stream:
+                tokens = np.asarray(batch["tokens"], np.int32)
+                sequences = generate(
+                    experiment.model,
+                    variables,
+                    tokens,
+                    max_new_tokens=experiment.max_new_tokens,
+                    temperature=experiment.temperature,
+                    top_k=experiment.top_k,
+                    top_p=getattr(experiment, "top_p", None),
+                    eos_token=experiment.eos_token,
+                )
+                extras = {
+                    key: np.asarray(value)
+                    for key, value in batch.items()
+                    if key != "tokens"
                 }
-                for key, value in extras.items():
-                    record[key] = np.asarray(value[row]).tolist()
-                out.write(json.dumps(record) + "\n")
-                records += 1
-            batches += 1
-            new_tokens += sequences.shape[0] * (
-                sequences.shape[1] - tokens.shape[1]
-            )
+                writer.put(tokens, sequences, extras)
+                batches += 1
+        except BaseException:
+            # Don't mask the pipeline error with a writer error; best-
+            # effort flush of what already decoded.
+            try:
+                writer.close()
+            except BaseException:  # noqa: BLE001 - original error wins
+                pass
+            raise
+        writer.close()
     elapsed = max(time.time() - t0, 1e-9)
     stats = {
-        "records": records,
+        "records": writer.records,
         "batches": batches,
         "ckpt_step": step,
-        "tokens_per_sec": round(new_tokens / elapsed, 2),
+        # Real throughput: per-row tokens up to the first eos. The
+        # repeated-eos fill after the on-device early exit is reported
+        # separately — counting it as generated inflated the number.
+        "tokens_per_sec": round(writer.real_tokens / elapsed, 2),
+        "padded_tokens_per_sec": round(writer.padded_tokens / elapsed, 2),
     }
+    from tf_yarn_tpu.models.decode_engine import get_engine
+
+    # Compile-cache visibility: a recompile storm (unbucketed shapes from
+    # a ragged input_fn) shows up right in the job stats.
+    stats["decode_engine"] = dict(get_engine(experiment.model).stats)
     _logger.info("inference done: %s", stats)
     return stats

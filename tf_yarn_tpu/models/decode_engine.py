@@ -1,0 +1,412 @@
+"""Persistent compiled decode engine: cached jit + on-device EOS loop.
+
+`models.generate.generate` paid three per-call taxes: a *fresh* jitted
+step closure per call (its compile cache died with the call), one host
+round-trip per generated token (`bool(finished.all())`), and a compiled
+shape per (batch, prompt-len) a caller happened to send. `DecodeEngine`
+removes all three:
+
+* **Cached AOT compiles.** Prefill is lowered+compiled once per
+  (batch-bucket, prompt-bucket) and the decode loop once per
+  batch-bucket; executables live on the engine and are reused across
+  batches. Every compile is logged with its key so recompile storms are
+  visible, and `stats` counts compiles vs cache hits.
+
+* **On-device decode loop.** The whole token loop is ONE
+  `jax.lax.while_loop` inside ONE compiled program: sampling, KV-cache
+  append, EOS-finished masking, and the all-finished early-exit
+  condition are all traced. Zero device→host transfers per token — the
+  only sync is the caller reading the finished sequences. The KV cache
+  and the output token buffer are donated (`donate_argnums`), so each
+  step updates HBM in place instead of double-buffering the cache.
+
+* **Shape bucketing.** Batch is padded UP to the next configured bucket
+  (pad rows are sliced back out). Prompt length is floor-bucketed:
+  prefill runs at the largest bucket <= P and the remaining P-F prompt
+  tokens are teacher-forced through the device loop (their K/V appended,
+  their sampled tokens discarded). Unlike right-padding the prompt, the
+  replay is *exact* — cache contents, RoPE positions, and the RNG stream
+  match the unbucketed path, so outputs are identical to
+  `generate_legacy` — while recompiles stay bounded by the bucket grid.
+
+The loop-trip-count inputs (actual replay length, max_new_tokens, the
+eos id, the PRNG seed) are traced scalars, so they never force a
+recompile; only shapes and the sampling configuration (temperature /
+top_k / top_p are baked into the traced program) key the cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tf_yarn_tpu.models.generate import _sample
+
+_logger = logging.getLogger(__name__)
+
+# Bucket grids: batch is ceil-padded, prompt is floor-bucketed (see
+# module docstring). Sizes outside the grid fall back to exact-shape
+# compiles, logged as unbucketed.
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+DEFAULT_PROMPT_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+# The output token buffer is sized in multiples of this, so max_new_tokens
+# only recompiles when it crosses a multiple, not on every value.
+DEFAULT_TOKEN_BUCKET = 64
+
+
+def build_prefill_fn(model):
+    """(params, prompt [B, F]) -> (cache, last-position logits [B, V])."""
+
+    def prefill(params, prompt):
+        logits, state = model.apply(
+            params, prompt, decode=True, mutable=["cache"]
+        )
+        return state["cache"], logits[:, -1]
+
+    return prefill
+
+
+def build_decode_fn(model, temperature: float, top_k: Optional[int],
+                    top_p: Optional[float], has_eos: bool, has_rest: bool):
+    """The single-program decode loop, shared by the engine and the
+    analysis jaxpr entry points.
+
+    has_rest=True signature:
+        fn(params, cache, rest, rest_len, num_new, rng, eos_id, out)
+    has_rest=False signature (prompt hit a bucket exactly — the first
+    token is sampled from the prefill logits, outside the loop):
+        fn(params, cache, last_logits, num_new, rng, eos_id, out)
+
+    `rest_len`, `num_new`, `eos_id` are traced scalars; `out` is the
+    preallocated token buffer [B, T] (pre-filled with eos when has_eos,
+    so the early-exit tail is already correct). Returns (filled buffer,
+    final cache): the caller donates `cache` and `out`, and returning
+    the cache gives XLA the output to alias the donated input against —
+    the loop carry then updates the prefill cache's HBM in place instead
+    of copying it into the program.
+
+    Loop-step semantics mirror generate_legacy exactly, including the
+    RNG split chain: replay steps (t < rest_len-1) consume no RNG; the
+    step at t == rest_len-1 samples the first generated token with the
+    first split (generate_legacy's prefill sample); each later step
+    advances the chain once.
+    """
+
+    def step_apply(params, cache, token):
+        logits, state = model.apply(
+            {**params, "cache": cache}, token[:, None], decode=True,
+            mutable=["cache"],
+        )
+        return state["cache"], logits[:, -1]
+
+    def make_loop(params, cache, rest, r, rng, eos_id, out,
+                  first_emitted, total):
+        w = rest.shape[1] if has_rest else 1
+        t_max = out.shape[1]
+
+        def cond(carry):
+            _cache, cur, _rng, finished, t, _out = carry
+            alive = t < total
+            if has_eos:
+                # cur is only an emitted token once generation started;
+                # during replay the exit check must stay off.
+                done = jnp.all(finished | (cur == eos_id))
+                alive = alive & ((t < r) | ~done)
+            return alive
+
+        def body(carry):
+            cache, cur, rng, finished, t, out = carry
+            if has_rest:
+                col = jax.lax.dynamic_slice_in_dim(
+                    rest, jnp.clip(t, 0, w - 1), 1, axis=1
+                )[:, 0]
+                token_in = jnp.where(t < r, col, cur)
+            else:
+                token_in = cur
+            cache, logits = step_apply(params, cache, token_in)
+            # Replay steps before the last consume no RNG and emit
+            # nothing — the split chain stays aligned with the
+            # unbucketed path's one-split-per-sample.
+            do_sample = t >= r - 1
+            next_rng, sample_key = jax.random.split(rng)
+            rng = jnp.where(do_sample, next_rng, rng)
+            sampled = _sample(logits, sample_key, temperature, top_k, top_p)
+            if has_eos:
+                # Generation steps after the first: a row that already
+                # emitted eos keeps emitting eos.
+                finished = jnp.where(
+                    t >= r, finished | (cur == eos_id), finished
+                )
+                emit = jnp.where(finished, eos_id, sampled)
+            else:
+                emit = sampled
+            cur = jnp.where(do_sample, emit, cur)
+            k = jnp.clip(t - r + 1, 0, t_max - 1)
+            written = jax.lax.dynamic_update_slice(
+                out, emit[:, None].astype(out.dtype), (0, k)
+            )
+            out = jnp.where(do_sample, written, out)
+            return cache, cur, rng, finished, t + 1, out
+
+        b = out.shape[0]
+        finished0 = jnp.zeros((b,), bool)
+        carry = (cache, first_emitted, rng, finished0,
+                 jnp.asarray(0, jnp.int32), out)
+        cache, _cur, _rng, _fin, _t, out = jax.lax.while_loop(
+            cond, body, carry
+        )
+        return out, cache
+
+    if has_rest:
+        def decode(params, cache, rest, rest_len, num_new, rng, eos_id, out):
+            b = out.shape[0]
+            cur0 = jnp.zeros((b,), jnp.int32)
+            total = rest_len + num_new - 1
+            return make_loop(params, cache, rest, rest_len, rng,
+                             eos_id, out, cur0, total)
+    else:
+        def decode(params, cache, last_logits, num_new, rng, eos_id, out):
+            rng, first_key = jax.random.split(rng)
+            first = _sample(last_logits, first_key, temperature, top_k, top_p)
+            out = jax.lax.dynamic_update_slice(
+                out, first[:, None].astype(out.dtype), (0, 0)
+            )
+            zero = jnp.asarray(0, jnp.int32)
+            return make_loop(params, cache, None, zero, rng,
+                             eos_id, out, first, num_new - 1)
+
+    return decode
+
+
+def _ceil_bucket(value: int, buckets: Tuple[int, ...]) -> Optional[int]:
+    for b in sorted(buckets):
+        if b >= value:
+            return b
+    return None
+
+
+def _floor_bucket(value: int, buckets: Tuple[int, ...]) -> Optional[int]:
+    best = None
+    for b in sorted(buckets):
+        if b <= value:
+            best = b
+    return best
+
+
+class DecodeEngine:
+    """Persistent compiled generation for one model (see module docstring).
+
+    Thread-safe for the compile cache; concurrent `generate` calls are
+    serialized only while looking up / inserting executables.
+    """
+
+    def __init__(
+        self,
+        model,
+        batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS,
+        prompt_buckets: Tuple[int, ...] = DEFAULT_PROMPT_BUCKETS,
+        token_bucket: int = DEFAULT_TOKEN_BUCKET,
+    ):
+        if token_bucket < 1:
+            raise ValueError(f"token_bucket must be >= 1, got {token_bucket}")
+        self.model = model
+        self.batch_buckets = tuple(sorted(set(batch_buckets)))
+        self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
+        self.token_bucket = int(token_bucket)
+        # One rest-buffer width for every bucketed prompt interval keeps
+        # the decode program shared across prompt buckets: the replay
+        # remainder is at most the widest gap in the grid.
+        gaps = [b2 - b1 for b1, b2 in zip(self.prompt_buckets,
+                                          self.prompt_buckets[1:])]
+        self._rest_width = max(gaps) if gaps else 1
+        self._prefill: Dict[tuple, Any] = {}
+        self._decode: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self.stats = {
+            "calls": 0,
+            "prefill_compiles": 0,
+            "decode_compiles": 0,
+            "prefill_cache_hits": 0,
+            "decode_cache_hits": 0,
+            "unbucketed_shapes": 0,
+        }
+
+    # -- bucket selection --------------------------------------------------
+
+    def select_buckets(self, batch: int, prompt_len: int) -> Tuple[int, int]:
+        """(padded batch, prefill length) for an incoming [B, P] batch.
+
+        Batch pads UP (extra rows are discarded); prompt floors DOWN
+        (the remainder replays through the decode loop). Out-of-grid
+        sizes return themselves — an exact-shape, logged compile.
+        """
+        b_bucket = _ceil_bucket(batch, self.batch_buckets) or batch
+        p_bucket = _floor_bucket(prompt_len, self.prompt_buckets) or prompt_len
+        # A remainder wider than the rest buffer (prompt beyond the
+        # grid) cannot replay — prefill the exact length instead.
+        if prompt_len - p_bucket > self._rest_width:
+            p_bucket = prompt_len
+        return b_bucket, p_bucket
+
+    def _params_fingerprint(self, params) -> int:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        return hash((treedef, tuple(
+            (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
+        )))
+
+    # -- compile cache -----------------------------------------------------
+
+    def _compiled(self, cache_dict, key, stat_prefix, build):
+        with self._lock:
+            compiled = cache_dict.get(key)
+            if compiled is not None:
+                self.stats[f"{stat_prefix}_cache_hits"] += 1
+                return compiled
+        # Compile outside the lock (slow); a racing duplicate compile is
+        # harmless — last writer wins, both executables are equivalent.
+        compiled = build()
+        with self._lock:
+            cache_dict[key] = compiled
+            self.stats[f"{stat_prefix}_compiles"] += 1
+            _logger.info(
+                "decode-engine compiled %s program for key=%s "
+                "(%d %s compiles, %d cached)",
+                stat_prefix, key, self.stats[f"{stat_prefix}_compiles"],
+                stat_prefix, len(cache_dict),
+            )
+        return compiled
+
+    # -- the public entry point --------------------------------------------
+
+    def generate(
+        self,
+        params,
+        prompt_tokens,
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+        eos_token: Optional[int] = None,
+    ):
+        """Drop-in `generate`: [B, P] -> [B, P + max_new_tokens] int32."""
+        prompt = jnp.asarray(prompt_tokens, jnp.int32)
+        b, prompt_len = prompt.shape
+        cfg = self.model.config
+        if prompt_len + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds config.max_seq_len ({cfg.max_seq_len}) — the KV "
+                "cache size"
+            )
+        if max_new_tokens == 0:
+            return prompt
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        fp = self._params_fingerprint(params)
+        with self._lock:
+            self.stats["calls"] += 1
+
+        b_bucket, f = self.select_buckets(b, prompt_len)
+        if b_bucket != (_ceil_bucket(b, self.batch_buckets) or -1) \
+                or f != (_floor_bucket(prompt_len, self.prompt_buckets) or -1):
+            with self._lock:
+                self.stats["unbucketed_shapes"] += 1
+            _logger.info(
+                "decode-engine: shape (B=%d, P=%d) outside the bucket grid "
+                "— exact-shape compile", b, prompt_len,
+            )
+        if b_bucket > b:
+            # Pad rows participate in every device op and are sliced
+            # away at the end; repeating a real row keeps them on the
+            # same numeric path as genuine inputs.
+            pad = jnp.broadcast_to(prompt[-1:], (b_bucket - b, prompt_len))
+            prompt_padded = jnp.concatenate([prompt, pad], axis=0)
+        else:
+            prompt_padded = prompt
+        rest_len = prompt_len - f
+        has_rest = rest_len > 0
+        has_eos = eos_token is not None
+
+        prefill_key = (b_bucket, f, fp)
+        prefill_fn = build_prefill_fn(self.model)
+        prefill_args = (params, prompt_padded[:, :f])
+        compiled_prefill = self._compiled(
+            self._prefill, prefill_key, "prefill",
+            lambda: jax.jit(prefill_fn).lower(*prefill_args).compile(),
+        )
+        cache, last_logits = compiled_prefill(*prefill_args)
+
+        t_max = -(-max_new_tokens // self.token_bucket) * self.token_bucket
+        out0 = jnp.full(
+            (b_bucket, t_max),
+            eos_token if has_eos else 0,
+            jnp.int32,
+        )
+        rng = jax.random.PRNGKey(seed)
+        num_new = jnp.asarray(max_new_tokens, jnp.int32)
+        eos_id = jnp.asarray(eos_token if has_eos else -1, jnp.int32)
+
+        decode_key = (b_bucket, t_max, has_rest, has_eos, float(temperature),
+                      top_k, top_p, fp)
+        if has_rest:
+            rest = jnp.zeros((b_bucket, self._rest_width), jnp.int32)
+            rest = jax.lax.dynamic_update_slice(
+                rest, prompt_padded[:, f:], (0, 0)
+            )
+            decode_args = (params, cache, rest,
+                           jnp.asarray(rest_len, jnp.int32), num_new, rng,
+                           eos_id, out0)
+            donate = (1, 7)
+        else:
+            decode_args = (params, cache, last_logits, num_new, rng, eos_id,
+                           out0)
+            donate = (1, 6)
+        decode_fn = build_decode_fn(
+            self.model, temperature, top_k, top_p, has_eos, has_rest
+        )
+        compiled_decode = self._compiled(
+            self._decode, decode_key, "decode",
+            lambda: jax.jit(decode_fn, donate_argnums=donate)
+            .lower(*decode_args).compile(),
+        )
+        # The returned final cache exists only to give the donated input
+        # cache an output to alias; dropping it frees the HBM.
+        out, _cache = compiled_decode(*decode_args)
+        generated = out[:b, :max_new_tokens]
+        return jnp.concatenate([prompt, generated], axis=1)
+
+
+# --------------------------------------------------------------------------
+# Module-level engine registry: `generate()` routes every caller through
+# a shared engine per model, so repeated calls — including the thin
+# compatibility wrapper's — hit the compile cache.
+# --------------------------------------------------------------------------
+
+_ENGINES: Dict[Any, DecodeEngine] = {}
+_ENGINES_LOCK = threading.Lock()
+
+
+def get_engine(model) -> DecodeEngine:
+    """The shared engine for `model` (flax modules hash by structure, so
+    equal configs share one engine; unhashable models fall back to
+    identity)."""
+    try:
+        key = model
+        hash(key)
+    except TypeError:
+        key = id(model)
+    with _ENGINES_LOCK:
+        engine = _ENGINES.get(key)
+        if engine is None:
+            engine = _ENGINES[key] = DecodeEngine(model)
+        return engine
+
+
+def clear_engines() -> None:
+    """Drop every cached engine (tests; frees compiled executables)."""
+    with _ENGINES_LOCK:
+        _ENGINES.clear()

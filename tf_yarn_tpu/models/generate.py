@@ -1,9 +1,17 @@
 """Autoregressive generation with KV-cache decoding.
 
 Inference for the decoder family: one prefill pass writes the prompt into
-each layer's KV cache, then a jitted single-token step samples and extends
-the cache — O(1) attention work per new token instead of re-running the
-full sequence. Greedy, temperature, top-k, and top-p (nucleus) sampling.
+each layer's KV cache, then a decode loop samples and extends the cache —
+O(1) attention work per new token instead of re-running the full
+sequence. Greedy, temperature, top-k, and top-p (nucleus) sampling.
+
+`generate` is a thin wrapper over the persistent compiled engine
+(`models.decode_engine.DecodeEngine`): prefill and the on-device decode
+loop are compiled once per shape bucket and reused across calls, the
+token loop runs as one `lax.while_loop` (EOS early-exit included — zero
+host syncs per token), and the KV cache is donated. `generate_legacy`
+keeps the original per-call-jit host loop for A/B benchmarking and
+equivalence tests.
 
 No reference analog (tf-yarn is a training launcher); provided because a
 complete model family needs an inference path.
@@ -28,7 +36,10 @@ def _sample(logits, rng, temperature: float, top_k: Optional[int],
         # sort per decode token would double the hot-path sort cost.
         sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
         if top_k is not None:
-            kth = sorted_desc[:, top_k - 1][:, None]
+            # top_k >= vocab keeps everything; unclamped it would index
+            # past the sorted row's end.
+            k = max(1, min(int(top_k), logits.shape[-1]))
+            kth = sorted_desc[:, k - 1][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
             # Mirror the mask in sorted space so top_p renormalizes over
             # the top_k-filtered distribution (value-based: ties at the
@@ -65,15 +76,57 @@ def generate(
     """Extend `prompt_tokens` [B, P] by up to `max_new_tokens`.
 
     `params` are unboxed variables ({"params": ...}); the KV cache is
-    created by the prefill apply (sized config.max_seq_len) and threaded
-    through a jitted decode step. Returns [B, P + max_new_tokens] int32
-    (positions after an eos_token, if given, repeat eos).
+    created by the prefill apply (sized config.max_seq_len) and updated
+    in place (donated) by the compiled decode loop. Returns
+    [B, P + max_new_tokens] int32 (positions after an eos_token, if
+    given, repeat eos).
 
     All prompts in a batch share length P (the prefill writes one cache
     offset for the whole batch). For ragged prompts, bucket requests by
     length (inference.py batches this way) — left-padding with per-row
     cache offsets is not supported.
+
+    Calls route through the module-level `DecodeEngine` for `model`
+    (`decode_engine.get_engine`): repeated calls in the same shape
+    bucket reuse one compiled prefill + decode program. When the batch
+    is padded up to a bucket, sampled (temperature > 0) draws for the
+    real rows can differ from an unpadded call — the categorical noise
+    is shaped by the padded batch — and low-precision compute (bf16) can
+    flip near-tied greedy argmaxes because the padded shape compiles to
+    a different fusion; construct a `DecodeEngine` with custom
+    `batch_buckets` when exact reproducibility across batch sizes
+    matters.
     """
+    from tf_yarn_tpu.models.decode_engine import get_engine
+
+    return get_engine(model).generate(
+        params,
+        prompt_tokens,
+        max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+        top_p=top_p,
+        seed=seed,
+        eos_token=eos_token,
+    )
+
+
+def generate_legacy(
+    model,
+    params,
+    prompt_tokens,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    seed: int = 0,
+    eos_token: Optional[int] = None,
+):
+    """The original host-driven decode loop: a fresh jitted step closure
+    per call and one device→host sync per token (`bool(finished.all())`).
+    Kept as the A/B baseline for the engine (benchmarks/run.py decode's
+    `percall_jit` variant) and as the reference the engine's bucketing
+    must reproduce exactly (tests/test_decode_engine.py)."""
     prompt_tokens = jnp.asarray(prompt_tokens, jnp.int32)
     b, prompt_len = prompt_tokens.shape
     cfg = model.config

@@ -103,6 +103,30 @@ def test_repo_passes_its_own_checker():
     )
 
 
+def test_checker_clean_over_telemetry_and_instrumented_sites():
+    """The telemetry layer's contract: instrumentation lives strictly
+    outside jit bodies. Linting the package plus every instrumented call
+    site directly (not just via the whole-tree run) pins the gate — a
+    span/clock/registry call smuggled into a jit body fails here."""
+    instrumented = [
+        "tf_yarn_tpu/telemetry",
+        "tf_yarn_tpu/training.py",
+        "tf_yarn_tpu/inference.py",
+        "tf_yarn_tpu/models/decode_engine.py",
+        "tf_yarn_tpu/checkpoint.py",
+        "tf_yarn_tpu/data/prefetch.py",
+        "tf_yarn_tpu/experiment.py",
+        "tf_yarn_tpu/tasks/worker.py",
+        "tf_yarn_tpu/event.py",
+        "tf_yarn_tpu/utils/metrics.py",
+    ]
+    paths = [os.path.join(REPO, p) for p in instrumented]
+    for path in paths:
+        assert os.path.exists(path), path
+    findings = analyze_paths(paths)
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_fixtures_fail_the_checker():
     proc = _run_checker(FIXTURES, "--no-jaxpr")
     assert proc.returncode == 1, proc.stdout + proc.stderr

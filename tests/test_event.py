@@ -57,3 +57,20 @@ def test_wait_helper():
     kv = InProcessKV()
     kv.put_str("k", "v")
     assert event.wait(kv, "k", timeout=1.0) == "v"
+
+
+def test_heartbeat_event_payload():
+    kv = InProcessKV()
+    before = time.time()
+    event.heartbeat_event(kv, "worker:3")
+    after = time.time()
+    assert before - 0.001 <= float(kv.get_str("worker:3/heartbeat")) <= after + 0.001
+    # Explicit timestamps pass through (the telemetry tests rely on it).
+    event.heartbeat_event(kv, "worker:3", timestamp=42.0)
+    assert kv.get_str("worker:3/heartbeat") == "42.000"
+
+
+def test_metrics_event_payload():
+    kv = InProcessKV()
+    event.metrics_event(kv, "worker:0", '{"train/steps_per_sec": 3.5}')
+    assert kv.get_str("worker:0/metrics") == '{"train/steps_per_sec": 3.5}'

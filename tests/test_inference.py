@@ -214,6 +214,14 @@ def test_pipeline_end_to_end_and_engine_stats(tmp_path, monkeypatch):
     assert stats["tokens_per_sec"] == stats["padded_tokens_per_sec"]
     # Same shape every batch: one compiled prefill + one decode program.
     assert stats["decode_engine"]["decode_compiles"] >= 1
+    # Telemetry: per-stage wall attribution of the pipeline ("write" runs
+    # on the writer thread) + how far the bounded queue backed up.
+    assert set(stats["stage_seconds"]) == {
+        "input_wait", "decode", "writer_put", "write"
+    }
+    assert all(v >= 0 for v in stats["stage_seconds"].values())
+    assert stats["stage_seconds"]["decode"] > 0
+    assert 1 <= stats["writer_queue_depth_max"] <= 1  # depth-1 queue
     records = [json.loads(line) for line in open(out_path)]
     assert [r["id"] for r in records] == list(range(8))
     for record in records:

@@ -87,6 +87,20 @@ def test_evaluator_metrics_logger_thresholds(caplog):
     assert any("Awake/idle ratio" in r.message for r in caplog.records)
 
 
+def test_collect_task_metrics_and_heartbeats():
+    from tf_yarn_tpu.utils.metrics import collect_task_metrics, task_heartbeats
+
+    kv = InProcessKV()
+    event.metrics_event(kv, "chief:0", '{"train/steps_per_sec": 2.0}')
+    event.metrics_event(kv, "worker:0", "garbage")
+    event.heartbeat_event(kv, "chief:0", timestamp=100.0)
+    collected = collect_task_metrics(kv, ["chief:0", "worker:0", "worker:1"])
+    assert collected == {"chief:0": {"train/steps_per_sec": 2.0}}
+    ages = task_heartbeats(kv, ["chief:0", "worker:0"], now=103.0)
+    assert ages["chief:0"] == 3.0
+    assert ages["worker:0"] is None  # never beat -> straggler candidate
+
+
 def test_one_shot_metrics_logger():
     kv = InProcessKV()
     logger = OneShotMetricsLogger(

@@ -163,6 +163,118 @@ def test_profile_window_captures_step_range(tmp_path, monkeypatch):
     assert glob.glob(f"{trace3}/**/*.xplane.pb", recursive=True)
 
 
+def test_hook_monotonic_clock_immune_to_wall_clock_skew(monkeypatch):
+    """Regression: the hook timed intervals with time.time(), so an NTP
+    step mid-interval corrupted steps/sec (and samples/sec, tokens/sec,
+    MFU). The clock is injectable and defaults to perf_counter; a
+    patched monotonic clock must fully determine the rates while
+    wall-clock jumps change nothing."""
+    from tf_yarn_tpu import training
+
+    logged = {}
+    monkeypatch.setattr(
+        training.mlflow, "log_metric",
+        lambda key, value, step=None: logged.setdefault(key, value),
+    )
+    # Wall clock jumping BACKWARD an hour mid-interval: with the old
+    # time.time() arithmetic elapsed would be negative (clamped to 1e-9,
+    # i.e. steps/sec ~ 1e10). The fake monotonic clock advances 2s.
+    fake = {"mono": 100.0}
+    monkeypatch.setattr(
+        training.time, "time", lambda: 1e9 - 3600.0
+    )
+    hook = training._StepsPerSecondHook(
+        None, every=4, samples_per_step=8, clock=lambda: fake["mono"]
+    )
+    fake["mono"] += 2.0
+    for _ in range(4):
+        hook.record_batch(8)
+    hook.after_step(4, {"loss": 1.0})
+    assert logged["steps_per_sec_0"] == pytest.approx(4 / 2.0)
+    assert logged["samples_per_sec_0"] == pytest.approx(8 * 4 / 2.0)
+
+
+def test_hook_forced_flush_empty_interval_skips_rates(monkeypatch):
+    """Regression: after_step(force=True) landing on an interval with
+    n_steps == 0 (final step coinciding with the last report) logged
+    steps_per_sec=0 / mfu=0 to MLflow, poisoning run charts. Empty
+    intervals now skip rate metrics entirely."""
+    from tf_yarn_tpu import training
+
+    calls = []
+    monkeypatch.setattr(
+        training.mlflow, "log_metric",
+        lambda key, value, step=None: calls.append((key, value)),
+    )
+    hook = training._StepsPerSecondHook(
+        None, every=5, samples_per_step=8, tokens_per_step=64,
+        flops_per_step=1e9, peak_flops=1e12,
+    )
+    hook.record_batch(8)
+    hook.after_step(5, {"loss": 1.0})  # normal report: rates present
+    assert any(k == "steps_per_sec_0" for k, _ in calls)
+    calls.clear()
+    hook.after_step(5, {"loss": 1.0}, force=True)  # empty interval
+    rate_keys = {k for k, _ in calls if not k.startswith("train")}
+    assert not any(
+        k.startswith(("steps_per_sec", "samples_per_sec",
+                      "tokens_per_sec", "mfu"))
+        for k in rate_keys
+    ), calls
+
+
+def test_profile_window_ignores_inverted_range(monkeypatch, caplog):
+    """Satellite: stop_step <= start_step selects no steps; previously
+    accepted silently and never captured. Now: warn + whole-run capture
+    (the malformed-window posture)."""
+    import logging as logging_mod
+
+    from tf_yarn_tpu import training
+
+    monkeypatch.setenv("TPU_YARN_PROFILE", "/tmp/unused-trace-dir")
+    monkeypatch.setenv("TPU_YARN_PROFILE_STEPS", "5:3")
+    with caplog.at_level(logging_mod.WARNING):
+        window = training._ProfileWindow()
+    assert window.start_step == 0 and window.stop_step is None
+    assert any("selects no steps" in r.message for r in caplog.records)
+    # Equal bounds are an empty window too.
+    monkeypatch.setenv("TPU_YARN_PROFILE_STEPS", "4:4")
+    window = training._ProfileWindow()
+    assert window.start_step == 0 and window.stop_step is None
+    # A valid window still applies.
+    monkeypatch.setenv("TPU_YARN_PROFILE_STEPS", "3:5")
+    window = training._ProfileWindow()
+    assert (window.start_step, window.stop_step) == (3, 5)
+
+
+def test_step_time_breakdown_sums_to_interval_wall(tmp_path):
+    """Telemetry smoke: after a run, the registry's per-component
+    interval gauges (input_wait, step_dispatch, device_wait,
+    checkpoint_save, host_other) sum to the interval wall time, and the
+    explicitly measured components cover a real share of it."""
+    from tf_yarn_tpu import telemetry
+
+    telemetry.get_registry().clear()
+    core = _mnist_core(
+        tmp_path, mesh_spec=MeshSpec(fsdp=8), train_steps=10,
+        log_every_steps=5, checkpoint_every_steps=5,
+    )
+    train_and_evaluate(core, devices=select_devices(8, platform="cpu"))
+    snap = telemetry.get_registry().snapshot()
+    prefix = "train/interval_seconds{component="
+    parts = {
+        k[len(prefix):-1]: v for k, v in snap.items() if k.startswith(prefix)
+    }
+    assert {"input_wait", "step_dispatch", "device_wait",
+            "checkpoint_save", "host_other", "interval_wall"} <= set(parts)
+    wall = parts.pop("interval_wall")
+    assert wall > 0
+    assert sum(parts.values()) == pytest.approx(wall, rel=0.05)
+    # The attribution is real, not all residual: measured components
+    # (everything but host_other) cover a meaningful share.
+    assert sum(parts.values()) - parts["host_other"] > 0.3 * wall
+
+
 def test_input_fn_start_step_receives_resume_point(tmp_path):
     # Input resume seam: an input_fn declaring `start_step` is told where
     # training resumes so it can skip consumed data; one without the

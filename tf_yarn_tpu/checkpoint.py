@@ -48,8 +48,18 @@ import tempfile
 from typing import Any, List, Optional
 
 from tf_yarn_tpu import fs as fs_lib
+from tf_yarn_tpu import telemetry
 
 _logger = logging.getLogger(__name__)
+
+
+def _observe_op(op: str, seconds: float) -> None:
+    """Checkpoint durations land in the process-global registry
+    (``checkpoint/seconds{op=...}``) so every run's snapshot carries
+    save/restore cost next to the step-time breakdown."""
+    telemetry.get_registry().histogram(
+        "checkpoint/seconds", op=op
+    ).observe(seconds)
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 
@@ -325,12 +335,14 @@ def _write_staged(model_dir: str, step: int, snapshot_holder: list) -> None:
     executor's work item (and the caller's frame) for the whole call, so
     the host-RAM copy would sit pinned through the slow network upload —
     the holder makes the release real, not cosmetic."""
-    with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
-        local = os.path.join(tmp, f"ckpt-{step}")
-        with _local_checkpointer() as ckptr:
-            ckptr.save(local, snapshot_holder[0], force=True)
-        snapshot_holder.clear()
-        _commit_staged(local, model_dir, step)
+    with telemetry.span("checkpoint/staged_write", step=step) as sp:
+        with tempfile.TemporaryDirectory(prefix="tpu-yarn-ckpt-stage-") as tmp:
+            local = os.path.join(tmp, f"ckpt-{step}")
+            with _local_checkpointer() as ckptr:
+                ckptr.save(local, snapshot_holder[0], force=True)
+            snapshot_holder.clear()
+            _commit_staged(local, model_dir, step)
+    _observe_op("staged_write", sp.duration)
 
 
 def _staged_save(model_dir: str, step: int, state: Any) -> None:
@@ -365,11 +377,13 @@ def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
     import orbax.checkpoint as ocp
 
     path = checkpoint_path(model_dir, step)
-    if _is_staged(model_dir):
-        _staged_save(model_dir, step, state)
-    else:
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(_orbax_target(model_dir, step), state, force=True)
+    with telemetry.span("checkpoint/save", step=step) as sp:
+        if _is_staged(model_dir):
+            _staged_save(model_dir, step, state)
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(_orbax_target(model_dir, step), state, force=True)
+    _observe_op("save", sp.duration)
     _logger.info("saved checkpoint %s", path)
     return path
 
@@ -406,16 +420,21 @@ class CheckpointWriter:
     def save(self, model_dir: str, step: int, state: Any) -> str:
         import orbax.checkpoint as ocp
 
-        self._gc(model_dir)
-        path = checkpoint_path(model_dir, step)
-        if _is_staged(model_dir):
-            self._staged_async_save(model_dir, step, state)
-        else:
-            self._ckptr.save(
-                _orbax_target(model_dir, step),
-                args=ocp.args.StandardSave(state),
-                force=True,
-            )
+        # save_submit prices only the blocking part (host snapshot /
+        # async enqueue) — the part the train loop actually stalls on;
+        # the background serialization shows up as staged_write / wait.
+        with telemetry.span("checkpoint/save_submit", step=step) as sp:
+            self._gc(model_dir)
+            path = checkpoint_path(model_dir, step)
+            if _is_staged(model_dir):
+                self._staged_async_save(model_dir, step, state)
+            else:
+                self._ckptr.save(
+                    _orbax_target(model_dir, step),
+                    args=ocp.args.StandardSave(state),
+                    force=True,
+                )
+        _observe_op("save_submit", sp.duration)
         _logger.info("checkpoint %s save started (async)", path)
         return path
 
@@ -502,8 +521,10 @@ class CheckpointWriter:
 
     def wait(self) -> None:
         """Block until every started save has committed."""
-        self._ckptr.wait_until_finished()
-        self._raise_staged_errors(block=True)
+        with telemetry.span("checkpoint/wait") as sp:
+            self._ckptr.wait_until_finished()
+            self._raise_staged_errors(block=True)
+        _observe_op("wait", sp.duration)
 
     def close(self) -> None:
         self._ckptr.close()
@@ -523,16 +544,20 @@ def restore_checkpoint(model_dir: str, step: int, target: Optional[Any] = None) 
     ShapeDtypeStructs with shardings) directs placement on restore."""
     import orbax.checkpoint as ocp
 
-    with _restorable_path(model_dir, step) as path:
-        with ocp.StandardCheckpointer() as ckptr:
-            if target is None:
-                return ckptr.restore(path)
-            import jax
+    with telemetry.span("checkpoint/restore", step=step) as sp:
+        with _restorable_path(model_dir, step) as path:
+            with ocp.StandardCheckpointer() as ckptr:
+                if target is None:
+                    restored = ckptr.restore(path)
+                else:
+                    import jax
 
-            abstract = jax.tree_util.tree_map(
-                ocp.utils.to_shape_dtype_struct, target
-            )
-            return ckptr.restore(path, abstract)
+                    abstract = jax.tree_util.tree_map(
+                        ocp.utils.to_shape_dtype_struct, target
+                    )
+                    restored = ckptr.restore(path, abstract)
+    _observe_op("restore", sp.duration)
+    return restored
 
 
 def restore_checkpoint_host(model_dir: str, step: int) -> Any:
@@ -543,14 +568,17 @@ def restore_checkpoint_host(model_dir: str, step: int) -> Any:
     import numpy as np
     import orbax.checkpoint as ocp
 
-    with _restorable_path(model_dir, step) as path:
-        with ocp.PyTreeCheckpointer() as ckptr:
-            item = ckptr.metadata(path).item_metadata
-            tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
-            restore_args = jax.tree_util.tree_map(
-                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
-            )
-            return ckptr.restore(path, restore_args=restore_args)
+    with telemetry.span("checkpoint/restore_host", step=step) as sp:
+        with _restorable_path(model_dir, step) as path:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                item = ckptr.metadata(path).item_metadata
+                tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
+                restore_args = jax.tree_util.tree_map(
+                    lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree
+                )
+                restored = ckptr.restore(path, restore_args=restore_args)
+    _observe_op("restore_host", sp.duration)
+    return restored
 
 
 def restore_latest(model_dir: str, target: Optional[Any] = None):

@@ -17,16 +17,31 @@ def prefetch(
     iterator: Iterator,
     place_fn: Optional[Callable] = None,
     depth: int = 2,
+    name: Optional[str] = None,
 ) -> Iterator:
     """Yield items from `iterator`, staging up to `depth` ahead.
 
     `place_fn` maps a host batch to device arrays (e.g. the train loop's
     batch globalizer); placement happens on the background thread so the
     consumer only ever sees device-resident batches.
+
+    `name` labels this pipeline in the telemetry registry: the staged
+    queue depth is published as ``prefetch/queue_depth{pipeline=name}``
+    on every put/get — a depth pinned at 0 is the "prefetch starved"
+    diagnosis behind a tokens/sec drop, pinned at `depth` means the
+    consumer (device) is the bottleneck.
     """
     if depth < 1:
         yield from (place_fn(item) if place_fn else item for item in iterator)
         return
+
+    depth_gauge = None
+    if name is not None:
+        from tf_yarn_tpu.telemetry import get_registry
+
+        depth_gauge = get_registry().gauge(
+            "prefetch/queue_depth", pipeline=name
+        )
 
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
@@ -44,6 +59,8 @@ def prefetch(
         while not stopped.is_set():
             try:
                 q.put(item, timeout=0.2)
+                if depth_gauge is not None:
+                    depth_gauge.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -64,6 +81,8 @@ def prefetch(
     try:
         while True:
             item = q.get()
+            if depth_gauge is not None:
+                depth_gauge.set(q.qsize())
             if item is _END:
                 return
             if isinstance(item, _Error):

@@ -35,6 +35,12 @@ CONTAINER_STOP_TIME = "container_stop_time"
 TRAIN_EVAL_START_TIME = "train_eval_start_time"
 TRAIN_EVAL_STOP_TIME = "train_eval_stop_time"
 
+# Telemetry stages (no reference analog — the unified telemetry layer,
+# tf_yarn_tpu.telemetry, publishes per-task liveness + metric snapshots
+# through the same KV protocol the lifecycle events use).
+HEARTBEAT = "heartbeat"
+METRICS = "metrics"
+
 
 def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
     """Block until `key` exists; returns its UTF-8 value (reference: event.py:13-30)."""
@@ -86,6 +92,22 @@ def train_eval_start_event(kv: KVStore, task: str) -> None:
 
 def train_eval_stop_event(kv: KVStore, task: str) -> None:
     broadcast(kv, f"{task}/{TRAIN_EVAL_STOP_TIME}", str(time.time()))
+
+
+def heartbeat_event(
+    kv: KVStore, task: str, timestamp: Optional[float] = None
+) -> None:
+    """Per-task liveness beacon: wall-clock seconds, compared across
+    hosts by utils.metrics.task_heartbeats (the one timer that SHOULD be
+    wall clock — ages are computed against the observer's clock)."""
+    ts = time.time() if timestamp is None else timestamp
+    broadcast(kv, f"{task}/{HEARTBEAT}", f"{ts:.3f}")
+
+
+def metrics_event(kv: KVStore, task: str, payload: str) -> None:
+    """Publish a task's telemetry-registry snapshot (a JSON object) as a
+    single key, aggregated chief-side exactly like last_training_step."""
+    broadcast(kv, f"{task}/{METRICS}", payload)
 
 
 def maybe_format_exception(exception: Optional[BaseException]) -> str:

@@ -356,11 +356,26 @@ EXPERIMENT_TYPES = (
 
 def run_experiment(runtime, experiment: Any) -> None:
     """Entry used by tasks/worker.py."""
-    if isinstance(experiment, InferenceExperiment):
-        from tf_yarn_tpu import inference
+    from tf_yarn_tpu import telemetry
 
-        inference.run_inference(experiment, runtime=runtime)
-        return
-    from tf_yarn_tpu import training
+    task = runtime.task if runtime is not None else "local"
+    try:
+        # Root span: the whole experiment body nests under it in the
+        # exported trace (TPU_YARN_TRACE), restore/compile/loop alike.
+        with telemetry.span(
+            "experiment/run", kind=type(experiment).__name__
+        ):
+            if isinstance(experiment, InferenceExperiment):
+                from tf_yarn_tpu import inference
 
-    training.train_and_evaluate(as_core_experiment(experiment), runtime=runtime)
+                inference.run_inference(experiment, runtime=runtime)
+                return
+            from tf_yarn_tpu import training
+
+            training.train_and_evaluate(
+                as_core_experiment(experiment), runtime=runtime
+            )
+    finally:
+        # Re-export so the root span (closed just now, after the runner's
+        # own export) is present; no-op without TPU_YARN_TRACE.
+        telemetry.export_trace(task)

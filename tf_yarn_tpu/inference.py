@@ -35,6 +35,7 @@ import numpy as np
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
 from tf_yarn_tpu import fs as fs_lib
+from tf_yarn_tpu import telemetry
 
 _logger = logging.getLogger(__name__)
 
@@ -116,10 +117,15 @@ class _JsonlWriter:
         self.records = 0
         self.real_tokens = 0
         self.padded_tokens = 0
+        self.write_seconds = 0.0
+        self.max_queue_depth = 0
         self._thread = threading.Thread(
             target=self._run, name="inference-writer", daemon=True
         )
         self._thread.start()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
 
     def _write_batch(self, tokens, sequences, extras) -> None:
         sequences = np.asarray(sequences)  # blocks on the device here
@@ -156,7 +162,15 @@ class _JsonlWriter:
             if self._exc is not None:
                 continue  # drain so the producer never blocks
             try:
-                self._write_batch(*item)
+                # Spanned on the writer thread: overlapped with the next
+                # batch's decode on the main thread, so this is I/O the
+                # pipeline hides — visible in the trace, not in elapsed.
+                with telemetry.span("inference/write_batch") as sp:
+                    self._write_batch(*item)
+                self.write_seconds += sp.duration
+                telemetry.get_registry().histogram(
+                    "inference/stage_seconds", stage="write"
+                ).observe(sp.duration)
             except BaseException as exc:  # noqa: BLE001 - re-raised in put/close
                 self._exc = exc
 
@@ -164,6 +178,11 @@ class _JsonlWriter:
         if self._exc is not None:
             raise self._exc
         self._q.put((tokens, sequences, extras))
+        depth = self._q.qsize()
+        self.max_queue_depth = max(self.max_queue_depth, depth)
+        telemetry.get_registry().gauge(
+            "inference/writer_queue_depth"
+        ).set(depth)
 
     def close(self) -> None:
         """Flush the queue, stop the thread, re-raise any writer error."""
@@ -181,15 +200,22 @@ def run_inference(experiment, runtime=None) -> dict:
     from tf_yarn_tpu.models.generate import generate
 
     shard, num_shards = 0, 1
+    telemetry_task = "inference"
     if runtime is not None:
         shard = runtime.task_key.id
         num_shards = sum(
             1 for ti in runtime.cluster_tasks if ti.key.type == runtime.task_key.type
         )
+        telemetry_task = getattr(
+            runtime, "task",
+            f"{runtime.task_key.type}:{runtime.task_key.id}",
+        )
+    telemetry.enable_env_jsonl(telemetry_task)
     allow_duplicate = getattr(experiment, "allow_duplicate_stream", False)
     _check_sharding_contract(experiment.input_fn, num_shards, allow_duplicate)
     fs_lib.check_model_dir_placement(experiment.model_dir)
-    variables, step = _restore_params(experiment.model_dir, experiment.step)
+    with telemetry.span("inference/restore_params"):
+        variables, step = _restore_params(experiment.model_dir, experiment.step)
     _logger.info(
         "inference from ckpt-%d, shard %d/%d -> %s",
         step, shard, num_shards, experiment.output_path,
@@ -199,8 +225,13 @@ def run_inference(experiment, runtime=None) -> dict:
     if num_shards > 1:
         out_path = f"{out_path}-{shard}"
 
+    registry = telemetry.get_registry()
+    stage_seconds = {"input_wait": 0.0, "decode": 0.0, "writer_put": 0.0}
     batches = 0
-    t0 = time.time()
+    # Monotonic clock: throughput over a wall-clock (time.time) interval
+    # was corrupted by NTP steps mid-job.
+    t0 = time.perf_counter()
+    _end = object()
     # output_path may be any fs URI (gs://, hdfs://, ...) — results land
     # where the fleet can read them, like every other model_dir artifact.
     with io.TextIOWrapper(fs_lib.open_output(out_path), encoding="utf-8") as out:
@@ -216,25 +247,48 @@ def run_inference(experiment, runtime=None) -> dict:
             stream = prefetch(
                 _call_input_fn(experiment.input_fn, shard, num_shards),
                 depth=getattr(experiment, "prefetch_depth", 2),
+                name="inference",
             )
-            for batch in stream:
+            while True:
+                # Blocked here = stage 1 starved (the prefetch queue-depth
+                # gauge pins at 0); blocked in put = stage 3 backed up.
+                with telemetry.span("inference/input_wait") as sp_in:
+                    batch = next(stream, _end)
+                if batch is _end:
+                    break
+                stage_seconds["input_wait"] += sp_in.duration
+                registry.histogram(
+                    "inference/stage_seconds", stage="input_wait"
+                ).observe(sp_in.duration)
                 tokens = np.asarray(batch["tokens"], np.int32)
-                sequences = generate(
-                    experiment.model,
-                    variables,
-                    tokens,
-                    max_new_tokens=experiment.max_new_tokens,
-                    temperature=experiment.temperature,
-                    top_k=experiment.top_k,
-                    top_p=getattr(experiment, "top_p", None),
-                    eos_token=experiment.eos_token,
-                )
+                with telemetry.span(
+                    "inference/decode", batch_index=batches
+                ) as sp_dec:
+                    sequences = generate(
+                        experiment.model,
+                        variables,
+                        tokens,
+                        max_new_tokens=experiment.max_new_tokens,
+                        temperature=experiment.temperature,
+                        top_k=experiment.top_k,
+                        top_p=getattr(experiment, "top_p", None),
+                        eos_token=experiment.eos_token,
+                    )
+                stage_seconds["decode"] += sp_dec.duration
+                registry.histogram(
+                    "inference/stage_seconds", stage="decode"
+                ).observe(sp_dec.duration)
                 extras = {
                     key: np.asarray(value)
                     for key, value in batch.items()
                     if key != "tokens"
                 }
-                writer.put(tokens, sequences, extras)
+                with telemetry.span("inference/writer_put") as sp_put:
+                    writer.put(tokens, sequences, extras)
+                stage_seconds["writer_put"] += sp_put.duration
+                registry.histogram(
+                    "inference/stage_seconds", stage="writer_put"
+                ).observe(sp_put.duration)
                 batches += 1
         except BaseException:
             # Don't mask the pipeline error with a writer error; best-
@@ -243,9 +297,11 @@ def run_inference(experiment, runtime=None) -> dict:
                 writer.close()
             except BaseException:  # noqa: BLE001 - original error wins
                 pass
+            telemetry.export_trace(telemetry_task)
             raise
         writer.close()
-    elapsed = max(time.time() - t0, 1e-9)
+    elapsed = max(time.perf_counter() - t0, 1e-9)
+    stage_seconds["write"] = writer.write_seconds
     stats = {
         "records": writer.records,
         "batches": batches,
@@ -255,6 +311,11 @@ def run_inference(experiment, runtime=None) -> dict:
         # separately — counting it as generated inflated the number.
         "tokens_per_sec": round(writer.real_tokens / elapsed, 2),
         "padded_tokens_per_sec": round(writer.padded_tokens / elapsed, 2),
+        # Per-stage wall attribution of the three-stage pipeline ("write"
+        # runs on the writer thread, overlapped with decode) + how far
+        # the bounded writer queue ever backed up.
+        "stage_seconds": {k: round(v, 4) for k, v in stage_seconds.items()},
+        "writer_queue_depth_max": writer.max_queue_depth,
     }
     from tf_yarn_tpu.models.decode_engine import get_engine
 
@@ -262,4 +323,10 @@ def run_inference(experiment, runtime=None) -> dict:
     # a ragged input_fn) shows up right in the job stats.
     stats["decode_engine"] = dict(get_engine(experiment.model).stats)
     _logger.info("inference done: %s", stats)
+    telemetry.flush_metrics(
+        registry,
+        kv=getattr(runtime, "kv", None),
+        task=telemetry_task if runtime is not None else None,
+    )
+    telemetry.export_trace(telemetry_task)
     return stats

@@ -44,6 +44,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from tf_yarn_tpu import telemetry
 from tf_yarn_tpu.models.generate import _sample
 
 _logger = logging.getLogger(__name__)
@@ -261,14 +262,25 @@ class DecodeEngine:
     # -- compile cache -----------------------------------------------------
 
     def _compiled(self, cache_dict, key, stat_prefix, build):
+        registry = telemetry.get_registry()
         with self._lock:
             compiled = cache_dict.get(key)
             if compiled is not None:
                 self.stats[f"{stat_prefix}_cache_hits"] += 1
+                registry.counter(
+                    "decode_engine/cache_hits", kind=stat_prefix
+                ).inc()
                 return compiled
         # Compile outside the lock (slow); a racing duplicate compile is
         # harmless — last writer wins, both executables are equivalent.
-        compiled = build()
+        with telemetry.span(
+            "decode_engine/compile", kind=stat_prefix, key=str(key)
+        ) as sp:
+            compiled = build()
+        registry.counter("decode_engine/compiles", kind=stat_prefix).inc()
+        registry.histogram(
+            "decode_engine/compile_seconds", kind=stat_prefix
+        ).observe(sp.duration)
         with self._lock:
             cache_dict[key] = compiled
             self.stats[f"{stat_prefix}_compiles"] += 1
@@ -309,12 +321,16 @@ class DecodeEngine:
         fp = self._params_fingerprint(params)
         with self._lock:
             self.stats["calls"] += 1
+        telemetry.get_registry().counter("decode_engine/calls").inc()
 
         b_bucket, f = self.select_buckets(b, prompt_len)
         if b_bucket != (_ceil_bucket(b, self.batch_buckets) or -1) \
                 or f != (_floor_bucket(prompt_len, self.prompt_buckets) or -1):
             with self._lock:
                 self.stats["unbucketed_shapes"] += 1
+            telemetry.get_registry().counter(
+                "decode_engine/unbucketed_shapes"
+            ).inc()
             _logger.info(
                 "decode-engine: shape (B=%d, P=%d) outside the bucket grid "
                 "— exact-shape compile", b, prompt_len,
@@ -338,7 +354,13 @@ class DecodeEngine:
             self._prefill, prefill_key, "prefill",
             lambda: jax.jit(prefill_fn).lower(*prefill_args).compile(),
         )
-        cache, last_logits = compiled_prefill(*prefill_args)
+        # Dispatch-side spans: async device futures, so these time the
+        # enqueue (host cost), not the device compute — the XLA profiler
+        # owns the device side.
+        with telemetry.span(
+            "decode_engine/prefill", batch=b_bucket, prompt=f
+        ):
+            cache, last_logits = compiled_prefill(*prefill_args)
 
         t_max = -(-max_new_tokens // self.token_bucket) * self.token_bucket
         out0 = jnp.full(
@@ -375,7 +397,8 @@ class DecodeEngine:
         )
         # The returned final cache exists only to give the donated input
         # cache an output to alias; dropping it frees the HBM.
-        out, _cache = compiled_decode(*decode_args)
+        with telemetry.span("decode_engine/decode", batch=b_bucket):
+            out, _cache = compiled_decode(*decode_args)
         generated = out[:b, :max_new_tokens]
         return jnp.concatenate([prompt, generated], axis=1)
 

@@ -16,8 +16,9 @@ For the function-of-rank mode use
 from __future__ import annotations
 
 import logging
+import os
 
-from tf_yarn_tpu import _task_commons, event
+from tf_yarn_tpu import _task_commons, event, telemetry
 from tf_yarn_tpu._internal import MonitoredThread
 from tf_yarn_tpu.tasks import _bootstrap
 
@@ -108,8 +109,23 @@ def main() -> None:
             args=(runtime, experiment),
             name=f"train-{runtime.task}",
         )
-        thread.start()
-        thread.join()
+        # Liveness + metrics beacon for the whole experiment: the chief
+        # reads {task}/heartbeat ages (utils.metrics.task_heartbeats) and
+        # the {task}/metrics registry snapshot, so a wedged worker is
+        # visible long before its container times out.
+        # TPU_YARN_HEARTBEAT_SECS=0 disables.
+        try:
+            heartbeat_every = float(
+                os.environ.get("TPU_YARN_HEARTBEAT_SECS", "") or 10.0
+            )
+        except ValueError:
+            heartbeat_every = 10.0
+        with telemetry.Heartbeat(
+            runtime.kv, runtime.task, every=heartbeat_every,
+            registry=telemetry.get_registry(),
+        ):
+            thread.start()
+            thread.join()
         event.train_eval_stop_event(runtime.kv, runtime.task)
         if thread.exception is not None:
             raise thread.exception

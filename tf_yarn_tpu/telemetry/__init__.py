@@ -1,0 +1,60 @@
+"""Unified runtime telemetry: spans, metrics, heartbeats.
+
+One subsystem behind every observability surface in the framework
+(docs/Observability.md):
+
+* :mod:`~tf_yarn_tpu.telemetry.spans` — nested, thread-aware span
+  tracing with a ring buffer, a JSONL sink, and a Chrome/Perfetto
+  ``trace_event`` exporter (``TPU_YARN_TRACE=<dir>`` →
+  ``trace_<task>.json``).
+* :mod:`~tf_yarn_tpu.telemetry.registry` — process-global
+  counters/gauges/histograms with labels, snapshot-able as a dict and
+  flushed to the log, MLflow, and the coordination KV store.
+* :mod:`~tf_yarn_tpu.telemetry.heartbeat` — per-task liveness gauges
+  over KV, so stragglers are visible from the chief.
+
+Everything is host-side: no instrument or span may live inside a jit
+body (the analysis checker gates the instrumented call sites in CI).
+"""
+
+from tf_yarn_tpu.telemetry.heartbeat import Heartbeat  # noqa: F401
+from tf_yarn_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    flush_metrics,
+    get_registry,
+)
+from tf_yarn_tpu.telemetry.spans import (  # noqa: F401
+    Span,
+    TRACE_ENV,
+    TRACE_JSONL_ENV,
+    Tracer,
+    close_jsonl_sinks,
+    enable_env_jsonl,
+    export_trace,
+    get_tracer,
+    span,
+    trace_dir,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Heartbeat",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "TRACE_ENV",
+    "TRACE_JSONL_ENV",
+    "Tracer",
+    "close_jsonl_sinks",
+    "enable_env_jsonl",
+    "export_trace",
+    "flush_metrics",
+    "get_registry",
+    "get_tracer",
+    "span",
+    "trace_dir",
+]

@@ -1,0 +1,106 @@
+"""Worker heartbeats over the coordination KV store.
+
+A tiny daemon thread broadcasting ``{task}/heartbeat`` (wall-clock
+timestamp — heartbeats are compared across hosts, where the shared NTP
+clock is the right reference; monotonic clocks are per-process) on a
+fixed cadence, optionally flushing the process-global metrics registry
+alongside. The chief (or any observer) turns the timestamps into ages
+with :func:`tf_yarn_tpu.utils.metrics.task_heartbeats` — a straggling
+or wedged worker shows up as a growing age long before its container
+times out, the liveness signal the reference's YARN AM provided for
+free and TPU slices don't.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from tf_yarn_tpu.telemetry.registry import MetricsRegistry, flush_metrics
+
+_logger = logging.getLogger(__name__)
+
+DEFAULT_EVERY_SECS = 10.0
+
+
+class Heartbeat:
+    """Periodic ``{task}/heartbeat`` broadcaster; ``every <= 0``
+    disables it (construction stays cheap so call sites don't branch).
+
+    KV errors are logged and swallowed — a flaky coordination link must
+    degrade liveness reporting, never kill the training thread's
+    process."""
+
+    def __init__(
+        self,
+        kv,
+        task: str,
+        every: float = DEFAULT_EVERY_SECS,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self._kv = kv
+        self._task = task
+        self._every = float(every)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.beats = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self._every > 0
+
+    def _beat(self) -> None:
+        from tf_yarn_tpu import event
+
+        try:
+            event.heartbeat_event(self._kv, self._task)
+            if self._registry is not None:
+                flush_metrics(
+                    self._registry, kv=self._kv, task=self._task,
+                    to_mlflow=False,
+                )
+            self.beats += 1
+        except Exception:
+            _logger.warning(
+                "heartbeat broadcast for %s failed", self._task, exc_info=True
+            )
+
+    def _run(self) -> None:
+        self._beat()
+        while not self._stop.wait(self._every):
+            self._beat()
+
+    def start(self) -> "Heartbeat":
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"heartbeat-{self._task}", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def heartbeat_age(raw: Optional[str], now: Optional[float] = None
+                  ) -> Optional[float]:
+    """Seconds since a raw heartbeat payload, or None when absent or
+    unparseable."""
+    if not raw:
+        return None
+    try:
+        return (time.time() if now is None else now) - float(raw)
+    except ValueError:
+        return None

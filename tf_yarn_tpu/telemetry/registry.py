@@ -1,0 +1,217 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+The numeric half of the telemetry layer (spans are the temporal half):
+instruments are named, optionally labeled, and live in one
+process-global registry so every subsystem — train loop, inference
+pipeline, decode engine, checkpointing — reports into the same
+snapshot. `snapshot()` flattens everything into a flat
+``{"name{label=value}": number}`` dict; `flush_metrics()` ships that
+snapshot to the log, MLflow, and the coordination KV store (one
+``{task}/metrics`` JSON payload via ``event.metrics_event``, so the
+chief aggregates per-host values exactly the way it reads
+``last_training_step`` today).
+
+Thread-safe throughout; instruments are cheap enough for per-step use
+(a lock + a float update). Everything here is host-side only — never
+call an instrument from inside a jit body (the analysis checker's
+TYA001-003 rules gate the instrumented call sites).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_logger = logging.getLogger(__name__)
+
+LabelKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _label_key(name: str, labels: Dict[str, Any]) -> LabelKey:
+    return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _format_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter; `inc` only."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Summary-stats histogram (count/sum/min/max/last): enough to
+    answer "how long do checkpoint saves take" without bucket-boundary
+    configuration; full distributions belong in the span trace."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.last = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.last = value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            if not self.count:
+                return {"count": 0.0, "sum": 0.0}
+            return {
+                "count": float(self.count),
+                "sum": self.total,
+                "mean": self.total / self.count,
+                "min": float(self.min),
+                "max": float(self.max),
+                "last": self.last,
+            }
+
+
+class MetricsRegistry:
+    """Named, labeled instruments; get-or-create accessors."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[LabelKey, Any] = {}
+
+    def _get(self, kind, name: str, labels: Dict[str, Any]):
+        key = _label_key(name, labels)
+        with self._lock:
+            instrument = self._instruments.get(key)
+            if instrument is None:
+                instrument = self._instruments[key] = kind()
+            elif not isinstance(instrument, kind):
+                raise TypeError(
+                    f"{_format_key(*key)} already registered as "
+                    f"{type(instrument).__name__}, not {kind.__name__}"
+                )
+            return instrument
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels: Any) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict of every instrument; histograms expand to
+        ``name_count/_sum/_mean/_min/_max/_last`` keys (labels kept)."""
+        with self._lock:
+            items = list(self._instruments.items())
+        out: Dict[str, float] = {}
+        for (name, labels), instrument in sorted(items):
+            if isinstance(instrument, Histogram):
+                for agg, value in instrument.summary().items():
+                    out[_format_key(f"{name}_{agg}", labels)] = value
+            else:
+                out[_format_key(name, labels)] = instrument.value
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+_GLOBAL_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _GLOBAL_REGISTRY
+
+
+def _mlflow_key(key: str) -> str:
+    # "a/b{c=d}" -> "a/b.c.d"; utils.mlflow.format_key then maps "/" too.
+    return re.sub(r"[{},=]+", ".", key).strip(".")
+
+
+def flush_metrics(
+    registry: Optional[MetricsRegistry] = None,
+    *,
+    step: Optional[int] = None,
+    kv=None,
+    task: Optional[str] = None,
+    to_mlflow: bool = True,
+    log_level: int = logging.DEBUG,
+) -> Dict[str, float]:
+    """Snapshot `registry` and ship it to every configured backend.
+
+    * log — one line at `log_level` (DEBUG by default: the train hook
+      already prints the headline numbers at INFO).
+    * MLflow — one ``log_metric`` per key (sanitized; the usual
+      swallow-connection-errors shim applies).
+    * KV — a single ``{task}/metrics`` JSON payload via
+      ``event.metrics_event`` when both `kv` and `task` are given.
+
+    Returns the snapshot."""
+    registry = registry or _GLOBAL_REGISTRY
+    snap = registry.snapshot()
+    if not snap:
+        return snap
+    if _logger.isEnabledFor(log_level):
+        _logger.log(
+            log_level, "metrics snapshot: %s",
+            " ".join(f"{k}={v:.6g}" for k, v in sorted(snap.items())),
+        )
+    if to_mlflow:
+        from tf_yarn_tpu.utils import mlflow
+
+        for key, value in snap.items():
+            mlflow.log_metric(_mlflow_key(key), value, step=step)
+    if kv is not None and task:
+        from tf_yarn_tpu import event
+
+        event.metrics_event(kv, task, json.dumps(snap, sort_keys=True))
+    return snap

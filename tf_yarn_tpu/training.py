@@ -34,7 +34,7 @@ import numpy as np
 import optax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
-from tf_yarn_tpu import event, fs as fs_lib, preemption
+from tf_yarn_tpu import event, fs as fs_lib, preemption, telemetry
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
@@ -76,14 +76,23 @@ def make_batch_globalizer(mesh):
     shardings_by_ndim: Dict[int, jax.sharding.NamedSharding] = {}
 
     def globalize(batch: Dict[str, np.ndarray]):
-        out = {}
-        for key, value in batch.items():
-            value = np.asarray(value)
-            shard = shardings_by_ndim.get(value.ndim)
-            if shard is None:
-                shard = mesh_lib.batch_sharding(mesh, extra_batch_dims=value.ndim - 1)
-                shardings_by_ndim[value.ndim] = shard
-            out[key] = jax.make_array_from_process_local_data(shard, value)
+        # Spanned + histogrammed, not in the interval breakdown: with the
+        # prefetch pipeline this runs on the producer thread, overlapped
+        # with device compute — its cost is real but not wall-serial.
+        with telemetry.span("train/globalize") as sp:
+            out = {}
+            for key, value in batch.items():
+                value = np.asarray(value)
+                shard = shardings_by_ndim.get(value.ndim)
+                if shard is None:
+                    shard = mesh_lib.batch_sharding(
+                        mesh, extra_batch_dims=value.ndim - 1
+                    )
+                    shardings_by_ndim[value.ndim] = shard
+                out[key] = jax.make_array_from_process_local_data(shard, value)
+        telemetry.get_registry().histogram(
+            "train/globalize_seconds"
+        ).observe(sp.duration)
         return out
 
     return globalize
@@ -181,24 +190,64 @@ def build_eval_step(model, loss_fn):
     return eval_step
 
 
+class _IntervalBreakdown:
+    """Host-side step-time attribution over one report interval.
+
+    The main loop thread accumulates named components (input_wait,
+    step_dispatch, device_wait, checkpoint_save, eval) between hook
+    reports; `report()` closes the interval, attributing whatever the
+    components didn't cover to ``host_other`` (preemption polls,
+    profiler toggles, loop bookkeeping) so the parts always sum to the
+    interval wall time — the MLPerf-style attribution that turns
+    "steps/sec dropped" into "input wait grew 40%"."""
+
+    def __init__(self, clock=None) -> None:
+        self._clock = clock or time.perf_counter
+        self._acc: Dict[str, float] = {}
+        self._t_start = self._clock()
+
+    def add(self, component: str, seconds: float) -> None:
+        self._acc[component] = self._acc.get(component, 0.0) + seconds
+
+    def report(self) -> Dict[str, float]:
+        """Close the interval: components + host_other + interval_wall."""
+        now = self._clock()
+        wall = max(now - self._t_start, 1e-9)
+        parts = dict(self._acc)
+        parts["host_other"] = max(0.0, wall - sum(parts.values()))
+        parts["interval_wall"] = wall
+        self._acc = {}
+        self._t_start = now
+        return parts
+
+
 class _StepsPerSecondHook:
     """Chief-only throughput reporting (reference StepPerSecondHook,
-    tensorflow/metrics.py:18-38): KV broadcast + MLflow + log.
+    tensorflow/metrics.py:18-38): KV broadcast + MLflow + log, now built
+    on the telemetry metrics registry (every report lands in process-
+    global gauges under ``train/*`` and the whole registry snapshot is
+    flushed to the log/MLflow/KV on the same cadence).
 
     Beyond the reference's steps/sec, every report carries samples/sec,
     tokens/sec (sequence batches) and **MFU** when the XLA cost analysis
     and chip peak are known — so every run, not just bench.py, records
-    how much of the hardware it used."""
+    how much of the hardware it used.
+
+    Timing uses a monotonic clock (perf_counter): the old wall-clock
+    ``time.time()`` deltas were corrupted by NTP steps, skewing
+    steps/sec and everything derived from it (tokens/sec, MFU)."""
 
     def __init__(self, runtime, every: int, n_try: int = 0,
                  resume_step: int = 0, flops_per_step: Optional[float] = None,
                  samples_per_step: Optional[int] = None,
                  tokens_per_step: Optional[int] = None,
-                 peak_flops: Optional[float] = None) -> None:
+                 peak_flops: Optional[float] = None,
+                 clock=None) -> None:
         self._runtime = runtime
         self._every = max(1, every)
         self._n_try = n_try
-        self._t0 = time.time()
+        self._clock = clock or time.perf_counter
+        self._t0 = self._clock()
         # Start counting at the resume step, or the first report after a
         # checkpoint restore would be inflated by resume_step/elapsed.
         self._step0 = resume_step
@@ -216,38 +265,60 @@ class _StepsPerSecondHook:
             n_samples if n_samples is not None else (self._samples_per_step or 0)
         )
 
-    def after_step(self, step: int, metrics: Dict[str, Any], force: bool = False) -> None:
+    def after_step(self, step: int, metrics: Dict[str, Any],
+                   force: bool = False,
+                   breakdown: Optional[Dict[str, float]] = None) -> None:
         if step % self._every != 0 and not force:
             return
-        now = time.time()
+        now = self._clock()
         elapsed = max(now - self._t0, 1e-9)
         n_steps = step - self._step0
-        steps_per_sec = n_steps / elapsed
-        # Fraction of assumed-full work actually done this interval
-        # (tokens and batch-dim FLOPs both scale with the sample count).
-        full = (self._samples_per_step or 0) * n_steps
-        work_frac = (
-            self._interval_samples / full
-            if full and self._interval_samples
-            else 1.0
-        )
+        interval_samples = self._interval_samples
         self._t0, self._step0 = now, step
         self._interval_samples = 0
         loss = metrics.get("loss")
-        report = {"steps_per_sec": steps_per_sec}
-        if self._samples_per_step:
-            report["samples_per_sec"] = (
-                steps_per_sec * self._samples_per_step * work_frac
+        report: Dict[str, float] = {}
+        if n_steps > 0:
+            steps_per_sec = n_steps / elapsed
+            # Fraction of assumed-full work actually done this interval
+            # (tokens and batch-dim FLOPs both scale with the sample
+            # count).
+            full = (self._samples_per_step or 0) * n_steps
+            work_frac = (
+                interval_samples / full
+                if full and interval_samples
+                else 1.0
             )
-        if self._tokens_per_step:
-            report["tokens_per_sec"] = (
-                steps_per_sec * self._tokens_per_step * work_frac
+            report["steps_per_sec"] = steps_per_sec
+            if self._samples_per_step:
+                report["samples_per_sec"] = (
+                    steps_per_sec * self._samples_per_step * work_frac
+                )
+            if self._tokens_per_step:
+                report["tokens_per_sec"] = (
+                    steps_per_sec * self._tokens_per_step * work_frac
+                )
+            mfu_value = flops_lib.mfu(
+                self._flops_per_step, steps_per_sec * work_frac,
+                self._peak_flops
             )
-        mfu_value = flops_lib.mfu(
-            self._flops_per_step, steps_per_sec * work_frac, self._peak_flops
-        )
-        if mfu_value is not None:
-            report["mfu"] = mfu_value
+            if mfu_value is not None:
+                report["mfu"] = mfu_value
+        # else: a forced flush landed on an interval with zero completed
+        # steps (e.g. final step coinciding with the last report) — every
+        # rate would be 0/epsilon garbage, so rate metrics are skipped
+        # entirely rather than reported as 0 to MLflow.
+        registry = telemetry.get_registry()
+        registry.counter("train/steps_total").inc(n_steps)
+        if interval_samples:
+            registry.counter("train/samples_total").inc(interval_samples)
+        for key, value in report.items():
+            registry.gauge(f"train/{key}").set(value)
+        if breakdown:
+            for component, seconds in breakdown.items():
+                registry.gauge(
+                    "train/interval_seconds", component=component
+                ).set(seconds)
         _logger.info(
             "step %d: loss=%s %s", step, loss,
             " ".join(f"{k}={v:.3f}" for k, v in report.items()),
@@ -264,6 +335,13 @@ class _StepsPerSecondHook:
             event.broadcast(
                 self._runtime.kv, f"{self._runtime.task}/last_training_step", str(step)
             )
+        # Registry snapshot → log (debug) + MLflow + one {task}/metrics
+        # KV payload, chief-aggregated like last_training_step.
+        telemetry.flush_metrics(
+            registry, step=step,
+            kv=self._runtime.kv if self._runtime is not None else None,
+            task=self._runtime.task if self._runtime is not None else None,
+        )
 
 
 def _preempt_agreed(state) -> bool:
@@ -359,8 +437,19 @@ class _ProfileWindow:
                     "ignoring malformed TPU_YARN_PROFILE_STEPS=%r "
                     "(want 'A:B', e.g. '100:110')", window)
             else:
-                self.start_step = parsed_start
-                self.stop_step = parsed_stop
+                if parsed_stop is not None and parsed_stop <= parsed_start:
+                    # An inverted/empty window selects no steps: the old
+                    # behavior accepted it silently and never captured.
+                    # Same posture as a malformed window: warn, capture
+                    # the whole run.
+                    _logger.warning(
+                        "ignoring TPU_YARN_PROFILE_STEPS=%r: stop_step "
+                        "(%d) <= start_step (%d) selects no steps; "
+                        "capturing the whole run instead",
+                        window, parsed_stop, parsed_start)
+                else:
+                    self.start_step = parsed_start
+                    self.stop_step = parsed_stop
 
     def boundaries(self):
         """Absolute steps where capture toggles — the train loop keeps
@@ -471,6 +560,12 @@ def train_and_evaluate(
     broadcasts) matches the reference's `_execute_dispatched_function`
     surface (tf_task_common.py:38-74) so run Metrics keep working.
     """
+    # Telemetry identity for this run: the launcher task when present
+    # ("worker:0"), a stable local name otherwise. TPU_YARN_TRACE=<dir>
+    # writes trace_<task>.json (Chrome trace_event) on exit — see
+    # docs/Observability.md.
+    telemetry_task = runtime.task if runtime is not None else "train"
+    telemetry.enable_env_jsonl(telemetry_task)
     params_cfg = core.train_params
     mesh_spec = core.mesh_spec
     if mesh_spec is None:
@@ -496,7 +591,8 @@ def train_and_evaluate(
     train_iter = _make_input_iter(
         core.train_input_fn, input_resume_step, _logger
     )
-    first_batch = next(train_iter)
+    with telemetry.span("train/first_batch"):
+        first_batch = next(train_iter)
     init_fn = core.init_fn or _default_init_fn(core.model)
     rng = jax.random.PRNGKey(params_cfg.seed)
     init_rng, train_rng = jax.random.split(rng)
@@ -526,13 +622,21 @@ def train_and_evaluate(
     state_shardings = _named_shardings(mesh, abstract_boxed)
 
     with mesh, contextlib.ExitStack() as _cleanup:
-        init_jit = jax.jit(init_state, out_shardings=state_shardings)
-        state = init_jit(init_rng, first_global)
+        # Registered first => runs last: the Chrome-trace export (no-op
+        # without TPU_YARN_TRACE) sees every span, including the cleanup
+        # callbacks', on success, crash and preemption paths alike.
+        _cleanup.callback(telemetry.export_trace, telemetry_task)
+        with telemetry.span("train/init"):
+            init_jit = jax.jit(init_state, out_shardings=state_shardings)
+            state = init_jit(init_rng, first_global)
 
         resume_step = 0
         ckpt_writer = None
         if core.model_dir:
-            restored, step = ckpt_lib.restore_latest(core.model_dir, target=state)
+            with telemetry.span("train/restore_latest"):
+                restored, step = ckpt_lib.restore_latest(
+                    core.model_dir, target=state
+                )
             if restored is not None:
                 state = restored
                 resume_step = int(step)
@@ -553,9 +657,10 @@ def train_and_evaluate(
         )
         # AOT-compile: the loop calls the compiled executable directly and
         # its XLA cost analysis prices one step for the MFU report.
-        train_step = train_step_jit.lower(
-            state, first_global, train_rng
-        ).compile()
+        with telemetry.span("train/compile_train_step"):
+            train_step = train_step_jit.lower(
+                state, first_global, train_rng
+            ).compile()
 
         # steps_per_loop > 1: a second executable scanning a whole block of
         # steps over stacked batches, so per-step dispatch (a real cost on
@@ -686,8 +791,11 @@ def train_and_evaluate(
         profile = _ProfileWindow()
         profile.on_step(resume_step)
 
-        batch_iter = prefetch(train_iter, place_fn=globalize, depth=2)
+        batch_iter = prefetch(
+            train_iter, place_fn=globalize, depth=2, name="train"
+        )
         batch = first_global
+        breakdown = _IntervalBreakdown()
         expected_shapes = tuple(
             a.shape for a in jax.tree_util.tree_leaves(first_global)
         )
@@ -699,12 +807,26 @@ def train_and_evaluate(
             leaves = jax.tree_util.tree_leaves(b)
             hook.record_batch(leaves[0].shape[0] if leaves else None)
 
+        def pull_batch():
+            """next(batch_iter) timed as input wait — the starvation
+            signal: a healthy prefetch returns instantly, a starved one
+            blocks here for the producer. StopIteration propagates (the
+            span still records; only the breakdown skips the final,
+            empty pull)."""
+            with telemetry.span("train/input_wait") as sp:
+                b = next(batch_iter)
+            breakdown.add("input_wait", sp.duration)
+            return b
+
         def run_single(state, b):
             nonlocal warned_ragged
             shapes = tuple(a.shape for a in jax.tree_util.tree_leaves(b))
             record(b)
             if shapes == expected_shapes:
-                return train_step(state, b, train_rng)
+                with telemetry.span("train/step_dispatch") as sp:
+                    out = train_step(state, b, train_rng)
+                breakdown.add("step_dispatch", sp.duration)
+                return out
             # Ragged batch (e.g. epoch tail): the AOT executable is
             # shape-locked, fall back to the retracing jit path.
             if not warned_ragged:
@@ -713,7 +835,10 @@ def train_and_evaluate(
                     "batch shapes changed mid-run; recompiling. Use "
                     "fixed-size batches (drop the epoch tail) on TPU."
                 )
-            return train_step_jit(state, b, train_rng)
+            with telemetry.span("train/step_dispatch", ragged=True) as sp:
+                out = train_step_jit(state, b, train_rng)
+            breakdown.add("step_dispatch", sp.duration)
+            return out
 
         def next_host_boundary(at):
             """First step > `at` where the loop must surface to the host."""
@@ -738,7 +863,7 @@ def train_and_evaluate(
                     chunk = [batch]
                     while len(chunk) < steps_per_loop:
                         try:
-                            chunk.append(next(batch_iter))
+                            chunk.append(pull_batch())
                         except StopIteration:
                             input_exhausted = True
                             break
@@ -748,10 +873,16 @@ def train_and_evaluate(
                         for b in chunk
                     )
                     if len(chunk) == steps_per_loop and uniform:
-                        stacked = stack_batches(*chunk)
-                        for b in chunk:
-                            record(b)
-                        state, metrics = multi_step(state, stacked, train_rng)
+                        with telemetry.span(
+                            "train/step_dispatch", steps=steps_per_loop
+                        ) as sp:
+                            stacked = stack_batches(*chunk)
+                            for b in chunk:
+                                record(b)
+                            state, metrics = multi_step(
+                                state, stacked, train_rng
+                            )
+                        breakdown.add("step_dispatch", sp.duration)
                         step += steps_per_loop
                         ran_chunk = True
                     else:
@@ -788,8 +919,11 @@ def train_and_evaluate(
                         "preemption drain at step %d: saving checkpoint", step
                     )
                     if core.model_dir:
-                        ckpt_writer.save(core.model_dir, step, state)
-                        ckpt_writer.wait()
+                        with telemetry.span(
+                            "train/checkpoint_save", step=step, drain=True
+                        ):
+                            ckpt_writer.save(core.model_dir, step, state)
+                            ckpt_writer.wait()
                     raise preemption.Preempted(
                         f"preempted at step {step}"
                         + (
@@ -799,12 +933,21 @@ def train_and_evaluate(
                         )
                     )
                 if (
-                    step % params_cfg.log_every_steps == 0
+                    (params_cfg.log_every_steps
+                     and step % params_cfg.log_every_steps == 0)
                     or step == params_cfg.train_steps
                 ):
+                    # Drain outstanding device work before reading the
+                    # metrics: attributed as device_wait (the compute
+                    # backlog async dispatch hid from the host so far).
+                    with telemetry.span("train/device_wait") as sp:
+                        metrics = jax.block_until_ready(metrics)
+                    breakdown.add("device_wait", sp.duration)
                     metrics_host = {k: float(v) for k, v in metrics.items()}
                     hook.after_step(
-                        step, metrics_host, force=step == params_cfg.train_steps
+                        step, metrics_host,
+                        force=step == params_cfg.train_steps,
+                        breakdown=breakdown.report(),
                     )
                     if tb_writer is not None:
                         for key, value in metrics_host.items():
@@ -814,7 +957,9 @@ def train_and_evaluate(
                     and step % params_cfg.checkpoint_every_steps == 0
                     and core.model_dir
                 ):
-                    ckpt_writer.save(core.model_dir, step, state)
+                    with telemetry.span("train/checkpoint_save", step=step) as sp:
+                        ckpt_writer.save(core.model_dir, step, state)
+                    breakdown.add("checkpoint_save", sp.duration)
                     if isinstance(tb_writer, _UploadingTbWriter):
                         # TB events survive a SIGKILL up to the last
                         # checkpoint boundary, like the model state does.
@@ -824,10 +969,12 @@ def train_and_evaluate(
                     and core.eval_input_fn
                     and step % params_cfg.eval_every_steps == 0
                 ):
-                    eval_metrics = evaluate(
-                        eval_step, state, core.eval_input_fn, globalize,
-                        params_cfg.eval_steps, train_rng,
-                    )
+                    with telemetry.span("train/eval", step=step) as sp:
+                        eval_metrics = evaluate(
+                            eval_step, state, core.eval_input_fn, globalize,
+                            params_cfg.eval_steps, train_rng,
+                        )
+                    breakdown.add("eval", sp.duration)
                     _logger.info("eval @ step %d: %s", step, eval_metrics)
                     if tb_writer is not None:
                         for key, value in eval_metrics.items():
@@ -837,7 +984,7 @@ def train_and_evaluate(
                         _logger.info("input exhausted at step %d", step)
                         break
                     try:
-                        batch = next(batch_iter)
+                        batch = pull_batch()
                     except StopIteration:
                         _logger.info("input exhausted at step %d", step)
                         break
@@ -853,13 +1000,15 @@ def train_and_evaluate(
                 k: float(v) for k, v in eval_step(state, batch, train_rng).items()
             }
         if core.model_dir:
-            ckpt_writer.save(core.model_dir, step, state)
-            ckpt_writer.wait()
+            with telemetry.span("train/checkpoint_save", step=step, final=True):
+                ckpt_writer.save(core.model_dir, step, state)
+                ckpt_writer.wait()
         if core.eval_input_fn:
-            final_eval = evaluate(
-                eval_step, state, core.eval_input_fn, globalize,
-                params_cfg.eval_steps, train_rng,
-            )
+            with telemetry.span("train/eval", final=True):
+                final_eval = evaluate(
+                    eval_step, state, core.eval_input_fn, globalize,
+                    params_cfg.eval_steps, train_rng,
+                )
             metrics_host.update({f"eval_{k}": v for k, v in final_eval.items()})
         # tb_writer closes (and, for remote model_dirs, uploads) via the
         # _cleanup stack on both the happy and the exception path.

@@ -7,7 +7,9 @@ folding in client.py:660-739 `_handle_events`).
 
 from __future__ import annotations
 
+import json
 import logging
+import time
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from tf_yarn_tpu import event
@@ -128,6 +130,44 @@ def handle_events(
         train_eval_time_per_node=train_eval,
     )
     return metrics, outcomes
+
+
+def collect_task_metrics(
+    kv: KVStore, tasks: List[str]
+) -> Dict[str, Dict[str, float]]:
+    """Latest telemetry-registry snapshot each task published via
+    ``event.metrics_event`` ({task}/metrics JSON) — the chief-side
+    aggregation seam for per-host step-time breakdowns, decode-engine
+    counters, checkpoint durations, etc. Tasks that never published (or
+    published garbage) are simply absent."""
+    out: Dict[str, Dict[str, float]] = {}
+    for task in tasks:
+        raw = kv.get_str(f"{task}/{event.METRICS}")
+        if not raw:
+            continue
+        try:
+            snap = json.loads(raw)
+        except ValueError:
+            _logger.warning("unparseable %s/%s payload", task, event.METRICS)
+            continue
+        if isinstance(snap, dict):
+            out[task] = snap
+    return out
+
+
+def task_heartbeats(
+    kv: KVStore, tasks: List[str], now: Optional[float] = None
+) -> Dict[str, Optional[float]]:
+    """Age in seconds of each task's last heartbeat (None = never beat).
+    A straggling/wedged worker shows as a growing age from the chief
+    long before its container times out."""
+    from tf_yarn_tpu.telemetry.heartbeat import heartbeat_age
+
+    now = time.time() if now is None else now
+    return {
+        task: heartbeat_age(kv.get_str(f"{task}/{event.HEARTBEAT}"), now=now)
+        for task in tasks
+    }
 
 
 class OneShotMetricsLogger:

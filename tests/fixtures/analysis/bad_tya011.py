@@ -1,0 +1,37 @@
+"""TYA011: retry loops with constant sleeps, silent broad-except swallows."""
+import time
+
+
+def fetch_with_blind_retries(fetch):
+    # Constant backoff inside a retry loop: every relaunch hammers the
+    # recovering service on the same cadence.
+    for _attempt in range(5):
+        try:
+            return fetch()
+        except ConnectionError:
+            time.sleep(2.0)
+    return None
+
+
+def poll_until_ready(probe):
+    while True:
+        try:
+            if probe():
+                return True
+        except OSError:
+            time.sleep(0.5)
+
+
+def swallow_everything(op):
+    try:
+        op()
+    except Exception:
+        pass
+
+
+def swallow_in_loop(ops):
+    for op in ops:
+        try:
+            op()
+        except Exception:
+            continue

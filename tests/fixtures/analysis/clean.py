@@ -74,3 +74,26 @@ def host_sync(fn, x):
 def suppressed_example(x):
     # An exotic-but-intended axis literal, explicitly waived.
     return jax.lax.psum(x, "exotic")  # noqa: TYA006
+
+
+def retry_with_backoff(fetch, base=0.5):
+    # A retry loop whose sleep is COMPUTED (backoff) is the legitimate
+    # twin of TYA011's constant-sleep pattern.
+    delay = base
+    for _attempt in range(5):
+        try:
+            return fetch()
+        except ConnectionError:
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+    return None
+
+
+def swallow_with_logging(op, logger):
+    # Broad catches that log (or classify / re-raise) are intentional
+    # swallows, not TYA011's silent ones.
+    try:
+        op()
+    except Exception:
+        logger.warning("best-effort op failed", exc_info=True)
+

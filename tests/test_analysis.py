@@ -110,10 +110,13 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
     span/clock/registry call smuggled into a jit body fails here."""
     instrumented = [
         "tf_yarn_tpu/telemetry",
+        "tf_yarn_tpu/resilience",
         "tf_yarn_tpu/training.py",
         "tf_yarn_tpu/inference.py",
         "tf_yarn_tpu/models/decode_engine.py",
         "tf_yarn_tpu/checkpoint.py",
+        "tf_yarn_tpu/client.py",
+        "tf_yarn_tpu/coordination/kv.py",
         "tf_yarn_tpu/data/prefetch.py",
         "tf_yarn_tpu/experiment.py",
         "tf_yarn_tpu/tasks/worker.py",

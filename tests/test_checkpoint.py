@@ -38,10 +38,20 @@ def test_retention_disabled_keeps_all(tmp_path):
     assert ckpt_lib.list_checkpoint_steps(model_dir) == [1, 2, 3]
 
 
-def test_staging_dirs_invisible(tmp_path):
+def test_staging_and_unmanifested_dirs_invisible(tmp_path):
+    # Orbax staging names never match; a name-matching tree WITHOUT a
+    # MANIFEST.json (crash between payload commit and manifest write) is
+    # equally invisible — the manifest is the completion marker.
     (tmp_path / "ckpt-7.orbax-checkpoint-tmp-1234").mkdir()
+    (tmp_path / "ckpt-5.corrupt").mkdir()
+    (tmp_path / "ckpt-9").mkdir()  # payload committed, manifest never landed
     (tmp_path / "ckpt-3").mkdir()
+    ckpt_lib.write_manifest(str(tmp_path / "ckpt-3"), step=3)
     assert ckpt_lib.list_checkpoint_steps(str(tmp_path)) == [3]
+    # Raw name-match view still exists for debris inspection.
+    assert ckpt_lib.list_checkpoint_steps(
+        str(tmp_path), require_manifest=False
+    ) == [3, 9]
 
 
 def test_save_returns_while_commit_in_flight(tmp_path, monkeypatch):

@@ -176,6 +176,89 @@ def test_run_on_tpu_ships_files_into_task_cwd(tmp_path):
     assert "worker-0-files" in cwd
 
 
+def _wedge_experiment_fn(started_dir):
+    """experiment_fn whose task parks until SIGTERM (preemption flag) or
+    60s — exits promptly on terminate, so kill paths don't ride the
+    SIGKILL escalation and tests stay fast. Everything is defined inside
+    so cloudpickle ships it by value (workers can't import test modules).
+    Touches a file per attempt under `started_dir`."""
+
+    def experiment_fn():
+        def run(params):
+            import os
+            import time
+            import uuid
+
+            from tf_yarn_tpu import preemption
+
+            open(os.path.join(started_dir, uuid.uuid4().hex), "w").close()
+            t0 = time.monotonic()
+            while (
+                time.monotonic() - t0 < 60.0 and not preemption.requested()
+            ):
+                time.sleep(0.1)
+
+        return run
+
+    return experiment_fn
+
+
+def test_timeout_secs_is_one_global_budget_across_retries(tmp_path):
+    """Regression for the per-attempt deadline bug: the old driver
+    recomputed `time.time() + timeout_secs` inside every attempt, so
+    nb_retries=3 could run 4x the requested timeout. Now the budget is
+    one monotonic Deadline across attempts: when the first attempt burns
+    it, no retry follows."""
+    import time as time_mod
+
+    started = tmp_path / "started"
+    started.mkdir()
+    t0 = time_mod.monotonic()
+    with pytest.raises(RunFailed):
+        run_on_tpu(
+            _wedge_experiment_fn(str(started)),
+            _worker_specs(instances=1),
+            custom_task_module=DISTRIBUTED,
+            nb_retries=3,
+            timeout_secs=4,
+            poll_every_secs=0.2,
+        )
+    elapsed = time_mod.monotonic() - t0
+    # The single attempt really ran (and only one did).
+    assert len(list(started.iterdir())) == 1
+    # Old semantics: 4 attempts x 4s >= 16s before even counting launch
+    # overhead. One global budget: a single killed attempt.
+    assert elapsed < 14, f"timeout budget leaked across retries: {elapsed:.1f}s"
+
+
+def test_heartbeat_watchdog_fails_wedged_task_fast(tmp_path):
+    """A task that beat once and went silent must fail the attempt as
+    LOST_TASK within ~dead_task_secs — not hang until timeout_secs."""
+    import time as time_mod
+
+    from tf_yarn_tpu.resilience import FailureKind
+
+    started = tmp_path / "started"
+    started.mkdir()
+    t0 = time_mod.monotonic()
+    with pytest.raises(RunFailed) as excinfo:
+        run_on_tpu(
+            _wedge_experiment_fn(str(started)),
+            _worker_specs(instances=1),
+            custom_task_module=DISTRIBUTED,
+            # One beat at startup, then silence (cadence far beyond the
+            # test): the watchdog must read that as a dead task.
+            env={"TPU_YARN_HEARTBEAT_SECS": "3600"},
+            dead_task_secs=2.0,
+            timeout_secs=45,
+            poll_every_secs=0.2,
+        )
+    elapsed = time_mod.monotonic() - t0
+    assert excinfo.value.kind is FailureKind.LOST_TASK
+    assert "heartbeat-silent" in str(excinfo.value)
+    assert elapsed < 30, f"watchdog too slow: {elapsed:.1f}s"
+
+
 def test_get_safe_experiment_fn():
     fn = get_safe_experiment_fn("os.getcwd")
     assert fn() == os.getcwd()

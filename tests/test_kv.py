@@ -183,6 +183,45 @@ def test_pooled_read_timeout_does_not_hang():
         silent.close()
 
 
+def test_kv_timeout_classified_transient(kv):
+    # The coordination timeout is an infra flake: the failure taxonomy
+    # must retry it, never charge it to user code.
+    from tf_yarn_tpu.resilience import FailureKind, classify_exception
+
+    with pytest.raises(KVTimeoutError) as excinfo:
+        kv.wait("never-published", timeout=0.05)
+    assert classify_exception(excinfo.value) is FailureKind.TRANSIENT
+    # The driver-side heuristic agrees when only traceback text survives
+    # (legacy stop payloads without a kind marker).
+    from tf_yarn_tpu.resilience import classify_stop_payload
+
+    kind, _ = classify_stop_payload(
+        "Traceback (most recent call last):\n...\n"
+        f"KVTimeoutError: {excinfo.value}"
+    )
+    assert kind is FailureKind.TRANSIENT
+
+
+def test_kv_chaos_delay_injection():
+    # TPU_YARN_FAULT kv_delay=p,secs lands in the client wrapper: every
+    # request pays the injected latency at p=1.0, deterministically.
+    from tf_yarn_tpu.coordination.kv import KVClient, start_server
+    from tf_yarn_tpu.resilience import chaos
+
+    server = start_server()
+    try:
+        client = KVClient(server.endpoint)
+        client.put("warm", b"1")  # connection setup outside the timing
+        chaos.configure("kv_delay=1.0,0.08", seed=0)
+        t0 = time.monotonic()
+        client.put("k", b"v")
+        assert client.get("k") == b"v"
+        assert time.monotonic() - t0 >= 0.16
+    finally:
+        chaos.reset()
+        server.stop()
+
+
 def test_keepalive_enabled_on_pooled_socket():
     import socket as socket_mod
 

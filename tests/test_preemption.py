@@ -120,6 +120,49 @@ def test_train_loop_drains_saves_and_resumes(tmp_path):
     assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == drained_at + 4
 
 
+def test_chaos_sigterm_drains_saves_and_classifies_preempted(tmp_path):
+    """Drain under chaos, end to end in-process: an injected SIGTERM
+    (TPU_YARN_FAULT sigterm_at_step=N) mid-run lands in the preemption
+    flag, the loop saves a drain checkpoint at the poll boundary, the
+    raised Preempted classifies PREEMPTED (zero transient budget spent),
+    and a resumed run completes from the drain step."""
+    from tf_yarn_tpu.experiment import as_core_experiment
+    from tf_yarn_tpu.models import mnist
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, select_devices
+    from tf_yarn_tpu.resilience import FailureKind, chaos, classify_exception
+    from tf_yarn_tpu.training import train_and_evaluate
+
+    model_dir = str(tmp_path / "model")
+    devices = select_devices(8, platform="cpu")
+
+    def make(train_steps):
+        exp = mnist.make_experiment(
+            model_dir=model_dir, train_steps=train_steps, batch_size=32,
+            feature_dim=16, num_classes=4, mesh_spec=MeshSpec(fsdp=8),
+            log_every_steps=2, checkpoint_every_steps=10,
+        )
+        exp.model = mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4)
+        return as_core_experiment(exp)
+
+    assert preemption.install()
+    chaos.configure("sigterm_at_step=3")
+    try:
+        with pytest.raises(preemption.Preempted, match="checkpoint saved") as ei:
+            train_and_evaluate(make(train_steps=10), devices=devices)
+        assert classify_exception(ei.value) is FailureKind.PREEMPTED
+    finally:
+        chaos.reset()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    steps = ckpt_lib.list_checkpoint_steps(model_dir)
+    assert steps == [3], steps  # drain checkpoint, manifest-verified
+    ckpt_lib.verify_checkpoint(f"{model_dir}/ckpt-3")
+
+    preemption.reset()
+    metrics = train_and_evaluate(make(train_steps=6), devices=devices)
+    assert np.isfinite(metrics["loss"])
+    assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == 6
+
+
 def test_launcher_retry_recovers_from_preemption(tmp_path):
     # Full path: Preempted ships through the stop event, the driver's
     # nb_retries relaunch resumes from the saved checkpoint.

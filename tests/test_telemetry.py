@@ -220,7 +220,7 @@ def test_collect_task_metrics_roundtrip():
 # --- heartbeat ------------------------------------------------------------
 
 def test_heartbeat_broadcasts_and_ages():
-    from tf_yarn_tpu.utils.metrics import task_heartbeats
+    from tf_yarn_tpu.utils.metrics import stopped_heartbeats, task_heartbeats
 
     kv = InProcessKV()
     reg = MetricsRegistry()
@@ -229,14 +229,20 @@ def test_heartbeat_broadcasts_and_ages():
         deadline = time.time() + 5
         while hb.beats < 2 and time.time() < deadline:
             time.sleep(0.01)
+        # Alive (no tombstone yet): the age is a liveness signal.
+        ts = float(kv.get_str("worker:0/heartbeat"))
+        ages = task_heartbeats(kv, ["worker:0", "worker:9"], now=ts + 4.0)
+        assert ages["worker:0"] == pytest.approx(4.0)
+        assert ages["worker:9"] is None  # never beat
     assert hb.beats >= 2
-    ts = float(kv.get_str("worker:0/heartbeat"))
     assert abs(time.time() - ts) < 60
     # Registry snapshot rode along on the beat.
     assert json.loads(kv.get_str("worker:0/metrics"))["depth"] == 3
-    ages = task_heartbeats(kv, ["worker:0", "worker:9"], now=ts + 4.0)
-    assert ages["worker:0"] == pytest.approx(4.0)
-    assert ages["worker:9"] is None
+    # Clean stop published the tombstone: finished, not dead — the task
+    # leaves the liveness view instead of showing a growing age.
+    assert kv.get_str("worker:0/heartbeat.stopped") is not None
+    assert "worker:0" not in task_heartbeats(kv, ["worker:0"], now=ts + 999)
+    assert stopped_heartbeats(kv, ["worker:0", "worker:9"]) == ["worker:0"]
 
 
 def test_heartbeat_disabled_with_nonpositive_cadence():

@@ -246,6 +246,7 @@ class _Linter(ast.NodeVisitor):
         self.jitted_names = _jitted_function_names(tree, self.aliases)
         self.findings: List[Finding] = []
         self._jit_depth = 0
+        self._tya011_sleeps: Set[Tuple[int, int]] = set()
 
     # -- helpers ----------------------------------------------------------
     def _add(self, node: ast.AST, code: str, message: str) -> None:
@@ -280,7 +281,57 @@ class _Linter(ast.NodeVisitor):
                 "bare `except:` catches KeyboardInterrupt/SystemExit; "
                 "use `except Exception` (or narrower)",
             )
+        else:
+            resolved = _resolve(_dotted(node.type), self.aliases)
+            if resolved in (
+                "Exception", "BaseException",
+                "builtins.Exception", "builtins.BaseException",
+            ) and all(
+                isinstance(stmt, (ast.Pass, ast.Continue))
+                for stmt in node.body
+            ):
+                # Narrow on purpose: a handler that logs, classifies, or
+                # re-raises is a legitimate intentional swallow — only
+                # the silent pass/continue on a broad catch is flagged.
+                self._add(
+                    node, "TYA011",
+                    "broad `except Exception` swallows the failure "
+                    "silently; classify it (tf_yarn_tpu.resilience."
+                    "classify_exception), log it, or re-raise",
+                )
         self.generic_visit(node)
+
+    def _check_constant_sleep_retry(self, loop: ast.AST) -> None:
+        """TYA011 (retry half): an except handler inside a loop that
+        sleeps a constant — a retry loop with no backoff. A sleep whose
+        argument is an expression/variable is presumed to be a computed
+        backoff and stays clean."""
+        for try_node in ast.walk(loop):
+            if not isinstance(try_node, ast.Try):
+                continue
+            for handler in try_node.handlers:
+                for sub in ast.walk(handler):
+                    if not (
+                        isinstance(sub, ast.Call)
+                        and _resolve(_dotted(sub.func), self.aliases)
+                        == "time.sleep"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Constant)
+                        and isinstance(sub.args[0].value, (int, float))
+                    ):
+                        continue
+                    key = (getattr(sub, "lineno", 0),
+                           getattr(sub, "col_offset", 0))
+                    if key in self._tya011_sleeps:
+                        continue  # nested loops both walk this handler
+                    self._tya011_sleeps.add(key)
+                    self._add(
+                        sub, "TYA011",
+                        "retry loop sleeps a constant "
+                        f"({sub.args[0].value!r}): no backoff/jitter — "
+                        "use tf_yarn_tpu.resilience.RetryPolicy (or "
+                        "compute the delay)",
+                    )
 
     def visit_Global(self, node: ast.Global) -> None:
         if self._in_jit:
@@ -312,6 +363,11 @@ class _Linter(ast.NodeVisitor):
 
     def visit_While(self, node: ast.While) -> None:
         self._check_truthiness(node, node.test)
+        self._check_constant_sleep_retry(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_constant_sleep_retry(node)
         self.generic_visit(node)
 
     def visit_Assert(self, node: ast.Assert) -> None:

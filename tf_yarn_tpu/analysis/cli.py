@@ -66,7 +66,7 @@ def _force_cpu() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except Exception:  # noqa: TYA011 — jax absent/locked: CPU narrowing is best-effort
         pass
 
 

@@ -82,6 +82,15 @@ _register(
     "compiled program; use jax.random with a threaded key", "ast",
 )
 
+_register(
+    "TYA011", "unclassified-retry",
+    "recovery code without a policy: a retry loop whose except handler "
+    "sleeps a constant (no backoff/jitter — synchronized relaunches "
+    "hammer a recovering service), or a broad `except Exception` that "
+    "swallows silently (pass/continue) instead of classifying "
+    "(tf_yarn_tpu.resilience), logging, or re-raising", "ast",
+)
+
 # --- jaxpr verifications -------------------------------------------------
 _register(
     "TYA101", "entry-point-trace-failure",

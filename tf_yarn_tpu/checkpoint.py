@@ -41,14 +41,17 @@ paths:
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import logging
 import os
 import re
 import tempfile
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from tf_yarn_tpu import fs as fs_lib
 from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.resilience import chaos as _chaos
 
 _logger = logging.getLogger(__name__)
 
@@ -66,26 +69,194 @@ _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 # Schemes orbax/tensorstore writes without staging.
 _ORBAX_NATIVE_SCHEMES = ("gs",)
 
+# Per-checkpoint integrity manifest: file sizes + checksums, written LAST
+# so its presence is the completion marker (docs/Resilience.md). Discovery
+# counts only manifested trees; restore verifies against it and
+# quarantines mismatches to ckpt-<step>.corrupt.
+MANIFEST_NAME = "MANIFEST.json"
+
+# TPU_YARN_CKPT_VERIFY: "sha256" (default) re-hashes every file on
+# restore; "size" checks sizes only (cheap safety for multi-GB
+# checkpoints on slow links); "off" trusts the bytes.
+_VERIFY_ENV = "TPU_YARN_CKPT_VERIFY"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint tree disagrees with its MANIFEST.json (or lacks one
+    where required): torn upload, truncated file, bit rot."""
+
 
 def checkpoint_path(model_dir: str, step: int) -> str:
     return fs_lib.join(model_dir, f"ckpt-{step}")
 
 
-def list_checkpoint_steps(model_dir: str) -> List[int]:
+def list_checkpoint_steps(
+    model_dir: str, require_manifest: bool = True
+) -> List[int]:
     """All completed checkpoint steps, ascending (reference's regex
     discovery, model_ckpt.py:15-28; works on any fs URI like the
-    reference's tf.io.gfile listing, evaluator_task.py:38-51)."""
+    reference's tf.io.gfile listing, evaluator_task.py:38-51).
+
+    Only *manifested* trees count: the manifest commits last, so a
+    half-written `ckpt-<step>` (crash between orbax commit and manifest)
+    is invisible to discovery, retention GC and the side-car evaluator
+    alike. `require_manifest=False` restores the raw name-match (debris
+    inspection, migration tooling)."""
     steps = []
     for name, is_dir in fs_lib.listdir(model_dir):
         match = _CKPT_RE.match(name)
-        if match and is_dir:
-            steps.append(int(match.group(1)))
+        if not (match and is_dir):
+            continue
+        if require_manifest and not fs_lib.exists(
+            fs_lib.join(model_dir, name, MANIFEST_NAME)
+        ):
+            continue
+        steps.append(int(match.group(1)))
     return sorted(steps)
 
 
 def latest_checkpoint_step(model_dir: str) -> Optional[int]:
     steps = list_checkpoint_steps(model_dir)
     return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# Manifest write / verify / quarantine
+# ---------------------------------------------------------------------------
+
+
+def _walk_ckpt_files(ckpt_uri: str) -> List[Tuple[str, int]]:
+    """Sorted [(relpath, size)] of every file under the tree, manifest
+    excluded."""
+    from pyarrow import fs as pafs
+
+    filesystem, root = fs_lib.resolve(ckpt_uri)
+    selector = pafs.FileSelector(root, recursive=True)
+    out: List[Tuple[str, int]] = []
+    for info in filesystem.get_file_info(selector):
+        if info.type != pafs.FileType.File:
+            continue
+        rel = info.path[len(root):].lstrip("/")
+        if rel == MANIFEST_NAME:
+            continue
+        out.append((rel, int(info.size or 0)))
+    return sorted(out)
+
+
+def _file_sha256(ckpt_uri: str, rel: str) -> str:
+    digest = hashlib.sha256()
+    with fs_lib.open_input(fs_lib.join(ckpt_uri, rel)) as stream:
+        while True:
+            chunk = stream.read(1 << 20)
+            if not chunk:
+                break
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def write_manifest(ckpt_uri: str, step: Optional[int] = None) -> Dict:
+    """Walk the committed tree and write MANIFEST.json (sizes + sha256).
+    This is the LAST write of a save — the completion marker discovery
+    keys on."""
+    files = {
+        rel: {"size": size, "sha256": _file_sha256(ckpt_uri, rel)}
+        for rel, size in _walk_ckpt_files(ckpt_uri)
+    }
+    payload = {"format": 1, "step": step, "files": files}
+    fs_lib.write_text(
+        fs_lib.join(ckpt_uri, MANIFEST_NAME),
+        json.dumps(payload, indent=1, sort_keys=True),
+    )
+    return payload
+
+
+def verify_checkpoint(ckpt_uri: str) -> None:
+    """Check the tree against its manifest; raises CheckpointCorrupt on
+    any disagreement. Depth set by TPU_YARN_CKPT_VERIFY (sha256|size|off)."""
+    mode = os.environ.get(_VERIFY_ENV, "sha256").lower()
+    if mode == "off":
+        return
+    manifest_uri = fs_lib.join(ckpt_uri, MANIFEST_NAME)
+    if not fs_lib.exists(manifest_uri):
+        raise CheckpointCorrupt(f"{ckpt_uri}: no {MANIFEST_NAME}")
+    try:
+        manifest = json.loads(fs_lib.read_text(manifest_uri))
+        expected = manifest["files"]
+    except (ValueError, KeyError, TypeError) as exc:
+        raise CheckpointCorrupt(
+            f"{ckpt_uri}: unparseable {MANIFEST_NAME}: {exc}"
+        ) from None
+    actual = dict(_walk_ckpt_files(ckpt_uri))
+    for rel, meta in expected.items():
+        if rel not in actual:
+            raise CheckpointCorrupt(f"{ckpt_uri}: missing file {rel!r}")
+        if int(meta.get("size", -1)) != actual[rel]:
+            raise CheckpointCorrupt(
+                f"{ckpt_uri}: size mismatch for {rel!r} "
+                f"(manifest {meta.get('size')}, on disk {actual[rel]})"
+            )
+        if mode == "sha256" and meta.get("sha256"):
+            got = _file_sha256(ckpt_uri, rel)
+            if got != meta["sha256"]:
+                raise CheckpointCorrupt(
+                    f"{ckpt_uri}: checksum mismatch for {rel!r}"
+                )
+
+
+def quarantine_checkpoint(model_dir: str, step: int) -> str:
+    """Move a corrupt ckpt-<step> aside to ckpt-<step>.corrupt (a name
+    discovery never matches) so restore falls back to the previous intact
+    step while the evidence survives for a post-mortem."""
+    src = checkpoint_path(model_dir, step)
+    dst = f"{src}.corrupt"
+    fs_lib.rmtree(dst)  # a re-quarantine of the same step replaces
+    fs_lib.move(src, dst)
+    _logger.error("quarantined corrupt checkpoint %s -> %s", src, dst)
+    return dst
+
+
+def latest_verified_step(model_dir: str) -> Optional[int]:
+    """Newest step whose tree passes manifest verification; corrupt trees
+    are quarantined on the way down. The resume/discovery entry point —
+    the train loop's input-resume step and restore_latest agree through
+    this."""
+    while True:
+        step = latest_checkpoint_step(model_dir)
+        if step is None:
+            return None
+        try:
+            verify_checkpoint(checkpoint_path(model_dir, step))
+        except CheckpointCorrupt as exc:
+            _logger.error(
+                "checkpoint verification failed (%s); falling back to the "
+                "previous step", exc,
+            )
+            quarantine_checkpoint(model_dir, step)
+            telemetry.get_registry().counter(
+                "checkpoint/quarantined_total"
+            ).inc()
+            continue
+        return step
+
+
+def _is_primary_process() -> bool:
+    """One manifest writer under multi-host (every host writes shards into
+    the same tree; process 0 stamps it after the collective commit)."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:  # pragma: no cover - jax-less tooling contexts
+        return True
+
+
+def _commit_manifest(ckpt_uri: str, step: int) -> None:
+    """Manifest + chaos commit hook: the shared epilogue of every save
+    path, on the elected writer only."""
+    if not _is_primary_process():
+        return
+    write_manifest(ckpt_uri, step=step)
+    _chaos.on_checkpoint_commit(ckpt_uri)
 
 
 def _is_staged(model_dir: str) -> bool:
@@ -341,7 +512,11 @@ def _write_staged(model_dir: str, step: int, snapshot_holder: list) -> None:
             with _local_checkpointer() as ckptr:
                 ckptr.save(local, snapshot_holder[0], force=True)
             snapshot_holder.clear()
+            # Manifest rides inside the staged tree: the rename-commit
+            # publishes payload and completion marker atomically.
+            write_manifest(local, step=step)
             _commit_staged(local, model_dir, step)
+            _chaos.on_checkpoint_commit(checkpoint_path(model_dir, step))
     _observe_op("staged_write", sp.duration)
 
 
@@ -383,6 +558,7 @@ def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
         else:
             with ocp.StandardCheckpointer() as ckptr:
                 ckptr.save(_orbax_target(model_dir, step), state, force=True)
+            _commit_manifest(path, step)
     _observe_op("save", sp.duration)
     _logger.info("saved checkpoint %s", path)
     return path
@@ -396,11 +572,12 @@ class CheckpointWriter:
     the train loop's `donate_argnums=(0,)` relies on this), then the
     serialization and the directory-rename commit run on background
     threads. Orbax writes into a `.orbax-checkpoint-tmp` staging dir and
-    renames on commit, and `list_checkpoint_steps`'s `ckpt-<step>` regex
-    never matches staging names — so a concurrently polling side-car
-    evaluator (evaluation.py) only ever sees completed checkpoints. The
-    same holds on staged-remote filesystems via `.staging-ckpt-<step>`
-    upload + rename.
+    renames on commit, and the MANIFEST.json completion marker (written
+    by the finalizer strictly after that commit) is what discovery keys
+    on — so a concurrently polling side-car evaluator (evaluation.py)
+    only ever sees completed, integrity-stamped checkpoints. The same
+    holds on staged-remote filesystems via `.staging-ckpt-<step>` upload
+    + rename (the manifest rides inside the staged tree).
 
     Retention: before each save, completed `ckpt-*` dirs beyond the
     newest `keep_last_n` are deleted (the Estimator-style keep_max
@@ -415,6 +592,7 @@ class CheckpointWriter:
         self.keep_last_n = keep_last_n
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         self._executor = None  # staged-upload worker, created on demand
+        self._finalizer = None  # manifest writer for async direct saves
         self._staged_futures: list = []
 
     def save(self, model_dir: str, step: int, state: Any) -> str:
@@ -434,9 +612,33 @@ class CheckpointWriter:
                     args=ocp.args.StandardSave(state),
                     force=True,
                 )
+                self._submit_finalize(model_dir, step)
         _observe_op("save_submit", sp.duration)
         _logger.info("checkpoint %s save started (async)", path)
         return path
+
+    def _submit_finalize(self, model_dir: str, step: int) -> None:
+        """Queue the manifest write to land strictly after orbax's async
+        commit — the manifest is the completion marker, so it cannot be
+        written from save() (the payload is still in flight). A dedicated
+        single worker keeps finalizations ordered; its failures surface
+        through the same once-only queue as staged-upload errors."""
+        import concurrent.futures
+
+        if self._finalizer is None:
+            self._finalizer = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-manifest"
+            )
+        self._staged_futures.append(
+            self._finalizer.submit(self._finalize_direct, model_dir, step)
+        )
+
+    def _finalize_direct(self, model_dir: str, step: int) -> None:
+        # Blocks until every in-flight orbax save (>= this step) has
+        # committed; a manifest written later than strictly necessary is
+        # fine, one written earlier would mark an incomplete tree.
+        self._ckptr.wait_until_finished()
+        _commit_manifest(checkpoint_path(model_dir, step), step)
 
     def _staged_async_save(self, model_dir: str, step: int, state: Any) -> None:
         """Snapshot to host now (preserving the donation guarantee), then
@@ -527,10 +729,14 @@ class CheckpointWriter:
         _observe_op("wait", sp.duration)
 
     def close(self) -> None:
-        self._ckptr.close()
+        # Drain background work BEFORE closing the checkpointer — the
+        # manifest finalizer waits on it and must not find it closed.
+        if self._finalizer is not None:
+            self._finalizer.shutdown(wait=True)
         if self._executor is not None:
             self._executor.shutdown(wait=True)
-            self._raise_staged_errors(block=True)
+        self._ckptr.close()
+        self._raise_staged_errors(block=True)
 
     def __enter__(self):
         return self
@@ -582,10 +788,16 @@ def restore_checkpoint_host(model_dir: str, step: int) -> Any:
 
 
 def restore_latest(model_dir: str, target: Optional[Any] = None):
-    """(state, step) of the newest checkpoint, or (None, None) — the resume
-    path the retry loop relies on (reference resumes from model_dir,
-    SURVEY.md §5 checkpoint/resume)."""
-    step = latest_checkpoint_step(model_dir)
+    """(state, step) of the newest *verified* checkpoint, or (None, None) —
+    the resume path the retry loop relies on (reference resumes from
+    model_dir, SURVEY.md §5 checkpoint/resume).
+
+    Every candidate is checked against its MANIFEST.json first; a tree
+    that fails verification is quarantined to ``ckpt-<step>.corrupt`` and
+    the previous intact step restores instead — resuming from a torn
+    checkpoint would silently train on garbage (or crash deep inside
+    orbax with no cause attached)."""
+    step = latest_verified_step(model_dir)
     if step is None:
         return None, None
     return restore_checkpoint(model_dir, step, target), step

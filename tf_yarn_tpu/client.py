@@ -28,8 +28,14 @@ from typing import Callable, Dict, List, Optional
 
 import cloudpickle
 
-from tf_yarn_tpu import _env, constants, event
+from tf_yarn_tpu import _env, constants, event, resilience, telemetry
 from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.resilience import (
+    Deadline,
+    FailureKind,
+    HeartbeatWatchdog,
+    RetryPolicy,
+)
 from tf_yarn_tpu.backends import (
     FAILED,
     KILLED,
@@ -63,7 +69,13 @@ ExperimentFn = Callable[[], object]
 
 
 class RunFailed(Exception):
-    """Raised when the experiment fails (reference: client.py:89-90)."""
+    """Raised when the experiment fails (reference: client.py:89-90).
+    Carries the attempt's :class:`~tf_yarn_tpu.resilience.FailureKind`
+    so callers (and the retry loop) can act on *why*."""
+
+    def __init__(self, message: str, kind: Optional[FailureKind] = None):
+        super().__init__(message)
+        self.kind = kind
 
 
 @dataclass
@@ -257,10 +269,18 @@ def _execute_and_await_termination(
     n_try: int,
     poll_every_secs: float,
     eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
-    timeout_secs: Optional[float] = None,
+    deadline: Optional[Deadline] = None,
+    dead_task_secs: Optional[float] = None,
 ) -> Metrics:
     """Post the experiment, poll to completion, fold events into Metrics
-    (reference: client.py:527-631)."""
+    (reference: client.py:527-631).
+
+    `deadline` is the run's ONE monotonic budget, shared across retries
+    (created once in run_on_tpu — recomputing it per attempt let
+    nb_retries=3 run 4x the requested timeout). `dead_task_secs` arms the
+    heartbeat watchdog: a task that beat once and then went silent that
+    long fails the attempt as LOST_TASK within a poll interval, instead
+    of hanging until the deadline."""
     cluster.kv.put(constants.KV_EXPERIMENT_FN, serialized_fn)
 
     evaluator_logger = EvaluatorMetricsLogger(
@@ -281,17 +301,43 @@ def _execute_and_await_termination(
         n_try,
     )
 
+    watchdog = None
+    if dead_task_secs:
+        watchdog = HeartbeatWatchdog(
+            cluster.kv, cluster.cluster_tasks, dead_task_secs
+        )
     status = RUNNING
-    deadline = time.time() + timeout_secs if timeout_secs else None
+    lost_tasks: List[str] = []
     while status == RUNNING:
         time.sleep(poll_every_secs)
         status = cluster.handle.status()
         evaluator_logger.log()
         tb_url_logger.log()
-        if deadline and time.time() > deadline and status == RUNNING:
+        if status != RUNNING:
+            break
+        if watchdog is not None:
+            lost_tasks = watchdog.poll()
+            if lost_tasks:
+                # Wedged-but-alive worker (host gone, partition, livelock):
+                # fail the attempt in seconds as LOST_TASK instead of
+                # burning the rest of the budget waiting on the deadline.
+                _logger.error(
+                    "heartbeat watchdog: %s silent > %.0fs; killing attempt",
+                    lost_tasks, dead_task_secs,
+                )
+                telemetry.get_registry().counter(
+                    "driver/lost_tasks_total"
+                ).inc(len(lost_tasks))
+                cluster.handle.kill()
+                status = KILLED
+                break
+        if deadline is not None and deadline.expired():
             # Hung cluster (deadlocked collective, stuck host): kill it so
             # the retry loop / caller gets control back.
-            _logger.error("run exceeded timeout_secs=%s; killing", timeout_secs)
+            _logger.error(
+                "run exceeded its %.0fs global budget; killing",
+                deadline.seconds,
+            )
             cluster.handle.kill()
             status = KILLED
             break
@@ -327,14 +373,45 @@ def _execute_and_await_termination(
             outcome.exception.strip().splitlines()[-1],
         )
     if status != "SUCCEEDED" or failures:
+        kind = _attempt_kind(outcomes, failures, lost_tasks)
         details = "\n".join(
             f"{task}: {outcome.exception}" for task, outcome in failures.items()
         )
+        if lost_tasks:
+            details = (
+                f"heartbeat-silent tasks declared lost: {lost_tasks}\n"
+                + details
+            )
         raise RunFailed(
-            f"run final status {status}; failed tasks: "
-            f"{sorted(failures) or 'none reported'}\n{details}"
+            f"run final status {status} (classified {kind.value}); "
+            f"failed tasks: {sorted(failures) or 'none reported'}\n{details}",
+            kind=kind,
         )
     return metrics
+
+
+def _attempt_kind(
+    outcomes: Dict[str, TaskOutcome],
+    failures: Dict[str, TaskOutcome],
+    lost_tasks: List[str],
+) -> FailureKind:
+    """Fold per-task failure kinds into the attempt's dominant kind (the
+    retry policy's input): FATAL_USER anywhere beats everything (a
+    relaunch reproduces it), a preemption explains collateral losses on
+    the same slice, and primaries killed without a stop event are lost
+    tasks."""
+    kinds = [FailureKind.LOST_TASK] * bool(lost_tasks)
+    kinds.extend(
+        outcome.kind or FailureKind.TRANSIENT for outcome in failures.values()
+    )
+    if not failures:
+        kinds.extend(
+            FailureKind.LOST_TASK
+            for task, outcome in outcomes.items()
+            if outcome.status == "KILLED"
+            and task.split(":", 1)[0] in PRIMARY_TASK_TYPES
+        )
+    return resilience.worst(kinds) or FailureKind.TRANSIENT
 
 
 def _print_failed_task_logs(
@@ -393,14 +470,29 @@ def run_on_tpu(
     requirements=None,
     wheels_dir: Optional[str] = None,
     nb_retries: int = 0,
+    retry_policy: Optional[RetryPolicy] = None,
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
+    dead_task_secs: Optional[float] = None,
     coordinator_bind: str = "127.0.0.1",
     coordinator_advertise: Optional[str] = None,
     eval_monitor_log_thresholds: Optional[Dict[str, tuple]] = None,
 ) -> Optional[Metrics]:
     """Run `experiment_fn` on a TPU slice (reference `run_on_yarn`,
-    client.py:299-466; same retry semantics: client.py:431-466).
+    client.py:299-466 — but with classified, budgeted retries in place
+    of its blind loop, client.py:431-466; docs/Resilience.md).
+
+    Failure handling: each failed attempt is classified (TRANSIENT /
+    PREEMPTED / LOST_TASK / FATAL_USER — `tf_yarn_tpu.resilience`) from
+    the tasks' stop events. `nb_retries=N` grants N retries *per
+    retryable kind* with exponential decorrelated-jitter backoff
+    (preemptions relaunch immediately; deterministic user errors consume
+    zero retries and raise at once). Pass `retry_policy` for explicit
+    budgets/backoff. `timeout_secs` is ONE monotonic budget over the
+    whole run, retries included. `dead_task_secs` (default: the
+    TPU_YARN_DEAD_TASK_SECS env) arms the heartbeat watchdog: a task
+    heartbeat-silent that long fails the attempt as LOST_TASK within a
+    poll interval.
 
     `experiment_fn` is a zero-arg closure returning one of the experiment
     types in `tf_yarn_tpu.experiment` (or, with the `distributed` task
@@ -469,6 +561,15 @@ def run_on_tpu(
                 files.setdefault(ship_name, ship_src)
     serialized_fn = cloudpickle.dumps(experiment_fn)
 
+    policy = retry_policy or RetryPolicy.from_nb_retries(nb_retries)
+    # ONE monotonic budget for the whole run: created before the first
+    # attempt, never recomputed (the old per-attempt time.time() deadline
+    # let nb_retries=3 run 4x timeout_secs, and NTP steps could stretch
+    # any attempt).
+    deadline = Deadline.after(timeout_secs)
+    if dead_task_secs is None:
+        dead_task_secs = resilience.dead_task_secs_from_env()
+
     n_try = 0
     while True:
         cluster: Optional[SliceCluster] = None
@@ -491,24 +592,54 @@ def run_on_tpu(
                 n_try,
                 poll_every_secs,
                 eval_monitor_log_thresholds,
-                timeout_secs,
+                deadline,
+                dead_task_secs,
             )
         except KeyboardInterrupt:
             _shutdown_on_exception(cluster, KILLED)
             raise
-        except Exception:
+        except Exception as exc:
             _shutdown_on_exception(cluster, FAILED)
-            if n_try < nb_retries:
-                _logger.exception("run attempt %d failed; retrying", n_try)
-                n_try += 1
-                continue
-            raise
+            kind = (
+                exc.kind
+                if isinstance(exc, RunFailed) and exc.kind is not None
+                # Driver-side failures (cluster setup, coordination):
+                # classified from the exception itself.
+                else resilience.classify_exception(exc)
+            )
+            delay = policy.next_delay(kind)
+            if delay is None:
+                _logger.error(
+                    "attempt %d failed (%s); not retrying (budget for "
+                    "%s: %d, spent: %d)", n_try, kind.value, kind.value,
+                    policy.budgets.get(kind, 0), policy.spent(kind),
+                )
+                raise
+            if deadline is not None and deadline.remaining() <= delay:
+                _logger.error(
+                    "attempt %d failed (%s) but the global %.0fs budget "
+                    "is exhausted; not retrying", n_try, kind.value,
+                    deadline.seconds,
+                )
+                raise
+            _logger.exception(
+                "run attempt %d failed (%s); retrying in %.1fs",
+                n_try, kind.value, delay,
+            )
+            telemetry.get_registry().counter(
+                "driver/retries_total", kind=kind.value
+            ).inc()
+            if delay:
+                time.sleep(delay)
+            n_try += 1
+            continue
         finally:
             if cluster is not None:
                 try:
                     cluster.server.stop()
                 except Exception:  # pragma: no cover - best-effort teardown
-                    pass
+                    _logger.debug("coordination server stop failed",
+                                  exc_info=True)
 
 
 def _shutdown_on_exception(cluster: Optional[SliceCluster], status: str) -> None:

@@ -37,7 +37,10 @@ class NativeServer:
         try:
             KVClient(self.endpoint).shutdown_server()
         except Exception:
-            pass
+            _logger.debug(
+                "coordd graceful shutdown request failed; terminating",
+                exc_info=True,
+            )
         if self._proc.poll() is None:
             self._proc.terminate()
         try:
@@ -65,7 +68,10 @@ def start_native_server(host: str = "127.0.0.1") -> Optional[NativeServer]:
                 _logger.info("native coordd serving on %s:%d", host, port)
                 return NativeServer(proc, host, port)
         except (ConnectionError, OSError, RuntimeError):
-            time.sleep(0.1)
+            # Startup probe, not a retry loop: a fixed 0.1s cadence against
+            # a process we just spawned locally is the point (bounded at
+            # 50 probes = 5s); backoff would only slow detection.
+            time.sleep(0.1)  # noqa: TYA011
     proc.terminate()
     _logger.warning("native coordd failed to come up; falling back to Python")
     return None

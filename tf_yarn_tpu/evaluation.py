@@ -107,7 +107,7 @@ def continuous_eval(
         try:
             jax.config.update("jax_platforms", platform)
         except Exception:  # pragma: no cover - backends already initialized
-            pass
+            _logger.debug("jax_platforms narrowing skipped", exc_info=True)
     core = as_core_experiment(experiment)
     if not core.model_dir:
         raise ValueError("continuous evaluation needs an experiment model_dir")

@@ -39,6 +39,10 @@ TRAIN_EVAL_STOP_TIME = "train_eval_stop_time"
 # tf_yarn_tpu.telemetry, publishes per-task liveness + metric snapshots
 # through the same KV protocol the lifecycle events use).
 HEARTBEAT = "heartbeat"
+# Tombstone published on clean Heartbeat.stop(): lets the watchdog (and
+# utils.metrics) distinguish "finished" from "died" — both used to look
+# like a growing heartbeat age.
+HEARTBEAT_STOPPED = "heartbeat.stopped"
 METRICS = "metrics"
 
 
@@ -67,7 +71,16 @@ def start_event(kv: KVStore, task: str) -> None:
 def stop_event(
     kv: KVStore, task: str, exception: Optional[BaseException] = None
 ) -> None:
-    broadcast(kv, f"{task}/{STOP}", maybe_format_exception(exception))
+    """Publish the task's terminal state. A failure payload leads with a
+    failure-kind marker line (resilience.taxonomy.encode_failure) so the
+    driver's retry policy knows *why* the attempt died without parsing
+    tracebacks; success stays the reference's empty string."""
+    if exception is None:
+        broadcast(kv, f"{task}/{STOP}", "")
+        return
+    from tf_yarn_tpu.resilience import taxonomy
+
+    broadcast(kv, f"{task}/{STOP}", taxonomy.encode_failure(exception))
 
 
 def logs_event(kv: KVStore, task: str, logs_location: str) -> None:
@@ -102,6 +115,17 @@ def heartbeat_event(
     wall clock — ages are computed against the observer's clock)."""
     ts = time.time() if timestamp is None else timestamp
     broadcast(kv, f"{task}/{HEARTBEAT}", f"{ts:.3f}")
+
+
+def heartbeat_stopped_event(
+    kv: KVStore, task: str, timestamp: Optional[float] = None
+) -> None:
+    """Final liveness tombstone on clean heartbeat shutdown: the task is
+    done beating on purpose. Consumers (resilience.HeartbeatWatchdog,
+    utils.metrics.task_heartbeats) treat tombstoned tasks as finished,
+    never as dead."""
+    ts = time.time() if timestamp is None else timestamp
+    broadcast(kv, f"{task}/{HEARTBEAT_STOPPED}", f"{ts:.3f}")
 
 
 def metrics_event(kv: KVStore, task: str, payload: str) -> None:

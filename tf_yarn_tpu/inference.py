@@ -295,7 +295,7 @@ def run_inference(experiment, runtime=None) -> dict:
             # effort flush of what already decoded.
             try:
                 writer.close()
-            except BaseException:  # noqa: BLE001 - original error wins
+            except BaseException:  # noqa: BLE001,TYA011 - original error wins
                 pass
             telemetry.export_trace(telemetry_task)
             raise

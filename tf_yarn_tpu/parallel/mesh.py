@@ -110,7 +110,7 @@ def select_devices(n: Optional[int] = None, platform: Optional[str] = None):
         try:
             jax.config.update("jax_platforms", platform)
         except Exception:  # pragma: no cover - late update after init
-            pass
+            _logger.debug("jax_platforms narrowing skipped", exc_info=True)
     devices = jax.devices(platform) if platform else jax.devices()
     if n is not None:
         if len(devices) < n:

@@ -1,0 +1,65 @@
+"""Fault tolerance: taxonomy, retries, watchdog, fault injection.
+
+The layer that turns the reference's blind ``nb_retries`` loop
+(reference: client.py:431-466) into classified, budgeted, observable
+recovery — the hot path on preemptible TPU slices:
+
+* :mod:`~tf_yarn_tpu.resilience.taxonomy` — why an attempt died
+  (TRANSIENT / PREEMPTED / LOST_TASK / FATAL_USER), serialized through
+  the stop event.
+* :mod:`~tf_yarn_tpu.resilience.retry` — per-kind budgets, decorrelated
+  jitter backoff, one global monotonic deadline.
+* :mod:`~tf_yarn_tpu.resilience.watchdog` — chief-side dead-task
+  detection from heartbeat ages (``TPU_YARN_DEAD_TASK_SECS``).
+* :mod:`~tf_yarn_tpu.resilience.chaos` — deterministic, seeded fault
+  injection (``TPU_YARN_FAULT``) behind the tier-1 kill/recover tests.
+
+Checkpoint integrity (MANIFEST.json, verified restore, quarantine)
+lives with the checkpoint code: :mod:`tf_yarn_tpu.checkpoint`.
+
+Full story: docs/Resilience.md.
+"""
+
+from tf_yarn_tpu.resilience import chaos  # noqa: F401
+from tf_yarn_tpu.resilience.chaos import (  # noqa: F401
+    FaultPlan,
+    InjectedFault,
+    parse_fault_spec,
+)
+from tf_yarn_tpu.resilience.retry import (  # noqa: F401
+    Deadline,
+    RetryDecision,
+    RetryPolicy,
+)
+from tf_yarn_tpu.resilience.taxonomy import (  # noqa: F401
+    FailureKind,
+    classify_exception,
+    classify_stop_payload,
+    encode_failure,
+    split_kind,
+    worst,
+)
+from tf_yarn_tpu.resilience.watchdog import (  # noqa: F401
+    ENV_DEAD_TASK_SECS,
+    HeartbeatWatchdog,
+    dead_task_secs_from_env,
+)
+
+__all__ = [
+    "Deadline",
+    "ENV_DEAD_TASK_SECS",
+    "FailureKind",
+    "FaultPlan",
+    "HeartbeatWatchdog",
+    "InjectedFault",
+    "RetryDecision",
+    "RetryPolicy",
+    "chaos",
+    "classify_exception",
+    "classify_stop_payload",
+    "dead_task_secs_from_env",
+    "encode_failure",
+    "parse_fault_spec",
+    "split_kind",
+    "worst",
+]

@@ -111,11 +111,21 @@ def main() -> None:
         ]
         event.start_event(runtime.kv, runtime.task)
         event.train_eval_start_event(runtime.kv, runtime.task)
+        # Same liveness beacon as the worker task program: the driver's
+        # heartbeat watchdog (TPU_YARN_DEAD_TASK_SECS) covers generic
+        # distributed fns too, not just JAX experiments.
+        from tf_yarn_tpu import telemetry
+
         try:
-            if nb_proc == 1:
-                fn(params_list[0])
-            else:
-                parallel_run(cloudpickle.dumps(fn), params_list)
+            with telemetry.Heartbeat(
+                runtime.kv, runtime.task,
+                every=telemetry.heartbeat.every_from_env(),
+                registry=telemetry.get_registry(),
+            ):
+                if nb_proc == 1:
+                    fn(params_list[0])
+                else:
+                    parallel_run(cloudpickle.dumps(fn), params_list)
         finally:
             event.train_eval_stop_event(runtime.kv, runtime.task)
 

@@ -16,7 +16,6 @@ For the function-of-rank mode use
 from __future__ import annotations
 
 import logging
-import os
 
 from tf_yarn_tpu import _task_commons, event, telemetry
 from tf_yarn_tpu._internal import MonitoredThread
@@ -110,18 +109,14 @@ def main() -> None:
             name=f"train-{runtime.task}",
         )
         # Liveness + metrics beacon for the whole experiment: the chief
-        # reads {task}/heartbeat ages (utils.metrics.task_heartbeats) and
-        # the {task}/metrics registry snapshot, so a wedged worker is
-        # visible long before its container times out.
-        # TPU_YARN_HEARTBEAT_SECS=0 disables.
-        try:
-            heartbeat_every = float(
-                os.environ.get("TPU_YARN_HEARTBEAT_SECS", "") or 10.0
-            )
-        except ValueError:
-            heartbeat_every = 10.0
+        # reads {task}/heartbeat ages (utils.metrics.task_heartbeats), the
+        # driver's watchdog turns silence past TPU_YARN_DEAD_TASK_SECS
+        # into a LOST_TASK failure, and the {task}/metrics registry
+        # snapshot rides along. TPU_YARN_HEARTBEAT_SECS=0 disables; a
+        # clean stop publishes a heartbeat.stopped tombstone.
         with telemetry.Heartbeat(
-            runtime.kv, runtime.task, every=heartbeat_every,
+            runtime.kv, runtime.task,
+            every=telemetry.heartbeat.every_from_env(),
             registry=telemetry.get_registry(),
         ):
             thread.start()

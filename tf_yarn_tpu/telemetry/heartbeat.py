@@ -14,6 +14,7 @@ free and TPU slices don't.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -23,6 +24,17 @@ from tf_yarn_tpu.telemetry.registry import MetricsRegistry, flush_metrics
 _logger = logging.getLogger(__name__)
 
 DEFAULT_EVERY_SECS = 10.0
+
+ENV_EVERY_SECS = "TPU_YARN_HEARTBEAT_SECS"
+
+
+def every_from_env(default: float = DEFAULT_EVERY_SECS) -> float:
+    """The heartbeat cadence from ``TPU_YARN_HEARTBEAT_SECS`` (0 disables);
+    the one parser every task program shares."""
+    try:
+        return float(os.environ.get(ENV_EVERY_SECS, "") or default)
+    except ValueError:
+        return default
 
 
 class Heartbeat:
@@ -82,10 +94,23 @@ class Heartbeat:
         return self
 
     def stop(self) -> None:
+        was_running = self._thread is not None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if was_running:
+            # Tombstone on clean shutdown: a finished task and a dead one
+            # both stop beating — the watchdog must only hunt the latter.
+            from tf_yarn_tpu import event
+
+            try:
+                event.heartbeat_stopped_event(self._kv, self._task)
+            except Exception:
+                _logger.warning(
+                    "heartbeat tombstone for %s failed", self._task,
+                    exc_info=True,
+                )
 
     def __enter__(self) -> "Heartbeat":
         return self.start()

@@ -34,7 +34,7 @@ import numpy as np
 import optax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
-from tf_yarn_tpu import event, fs as fs_lib, preemption, telemetry
+from tf_yarn_tpu import event, fs as fs_lib, preemption, resilience, telemetry
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
@@ -587,7 +587,10 @@ def train_and_evaluate(
     input_resume_step = 0
     if core.model_dir:
         fs_lib.check_model_dir_placement(core.model_dir)
-        input_resume_step = ckpt_lib.latest_checkpoint_step(core.model_dir) or 0
+        # Verified discovery: a corrupt newest checkpoint is quarantined
+        # HERE, before the input iterator is built, so the input-resume
+        # step and the step restore_latest lands on below cannot diverge.
+        input_resume_step = ckpt_lib.latest_verified_step(core.model_dir) or 0
     train_iter = _make_input_iter(
         core.train_input_fn, input_resume_step, _logger
     )
@@ -897,6 +900,12 @@ def train_and_evaluate(
                     state, metrics = run_single(state, batch)
                     step += 1
                 profile.on_step(step, state)
+                # Deterministic fault injection at the host boundary
+                # (TPU_YARN_FAULT crash_at_step / sigterm_at_step): a
+                # cached no-op when chaos is unarmed. SIGTERM lands in
+                # the preemption flag and drains through the poll below;
+                # an injected crash propagates like any runtime abort.
+                resilience.chaos.on_train_step(step)
                 if (
                     not input_exhausted
                     and step < params_cfg.train_steps

@@ -15,6 +15,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 from tf_yarn_tpu import event
 from tf_yarn_tpu.backends import PRIMARY_TASK_TYPES
 from tf_yarn_tpu.coordination.kv import KVStore
+from tf_yarn_tpu.resilience.taxonomy import FailureKind, classify_stop_payload
 from tf_yarn_tpu.utils import mlflow
 
 _logger = logging.getLogger(__name__)
@@ -45,10 +46,13 @@ class Metrics(NamedTuple):
 
 class TaskOutcome(NamedTuple):
     """Final state of one task, derived from its event set
-    (reference: client.py:660-695)."""
+    (reference: client.py:660-695). `kind` is the failure classification
+    the task serialized through its stop event (resilience.taxonomy) —
+    None for non-failures."""
 
     status: str  # SUCCEEDED | FAILED | KILLED | REQUESTED
     exception: str  # traceback text, "" on success
+    kind: Optional["FailureKind"] = None
 
 
 def _get_float(kv_snapshot: Dict[str, str], key: str) -> Optional[float]:
@@ -97,7 +101,11 @@ def handle_events(
         elif stop_payload == "":
             outcomes[task] = TaskOutcome("SUCCEEDED", "")
         else:
-            outcomes[task] = TaskOutcome("FAILED", stop_payload)
+            # The payload leads with a failure-kind marker when the task
+            # classified its own death (resilience.taxonomy); strip it so
+            # callers see plain traceback text, keep the kind first-class.
+            kind, text = classify_stop_payload(stop_payload)
+            outcomes[task] = TaskOutcome("FAILED", text, kind)
 
         c_start = _get_float(snapshot, f"{task}/{event.CONTAINER_START_TIME}")
         c_stop = _get_float(snapshot, f"{task}/{event.CONTAINER_STOP_TIME}")
@@ -160,14 +168,28 @@ def task_heartbeats(
 ) -> Dict[str, Optional[float]]:
     """Age in seconds of each task's last heartbeat (None = never beat).
     A straggling/wedged worker shows as a growing age from the chief
-    long before its container times out."""
+    long before its container times out.
+
+    Tasks that published a ``heartbeat.stopped`` tombstone (clean
+    Heartbeat shutdown) are EXCLUDED: finished is not a liveness concern,
+    and before the tombstone a finished task and a dead one both looked
+    like a growing age. ``stopped_heartbeats`` lists them."""
     from tf_yarn_tpu.telemetry.heartbeat import heartbeat_age
 
     now = time.time() if now is None else now
     return {
         task: heartbeat_age(kv.get_str(f"{task}/{event.HEARTBEAT}"), now=now)
         for task in tasks
+        if kv.get_str(f"{task}/{event.HEARTBEAT_STOPPED}") is None
     }
+
+
+def stopped_heartbeats(kv: KVStore, tasks: List[str]) -> List[str]:
+    """Tasks that cleanly tombstoned their heartbeat (finished, not dead)."""
+    return [
+        task for task in tasks
+        if kv.get_str(f"{task}/{event.HEARTBEAT_STOPPED}") is not None
+    ]
 
 
 class OneShotMetricsLogger:

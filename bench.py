@@ -354,6 +354,16 @@ def _stale_tpu_fields() -> dict:
         fields["last_tpu_longctx_tokens_per_sec"] = longctx[
             "tokens_per_sec_per_chip"
         ]
+    serve = table.get("serve") or {}
+    for policy in ("continuous", "static"):
+        row = serve.get(policy) or {}
+        if "tokens_per_sec" in row:
+            fields[f"last_tpu_serve_{policy}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            fields[f"last_tpu_serve_{policy}_ttft_p95_ms"] = row.get(
+                "ttft_p95_ms"
+            )
     return fields
 
 
@@ -548,8 +558,8 @@ def bench_flagship_train():
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "rows": table,
     }
-    for section in ("decode", "long_context", "bert_base", "resnet50",
-                    "vit_base"):
+    for section in ("decode", "long_context", "serve", "bert_base",
+                    "resnet50", "vit_base"):
         if previous.get(section):
             ab[section] = {
                 **previous[section],
@@ -587,6 +597,23 @@ def bench_flagship_train():
             _log(f"decode: {decode}")
         except Exception as exc:
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
+        try:
+            serve = suite.bench_serve(tpu=True)
+            ab["serve"] = serve
+            _write_ab(ab)
+            # Online-serving headline pair: continuous-batching
+            # throughput + tail TTFT, with the static-batching baseline
+            # alongside (same engine, same trace — policy-only delta).
+            for policy in ("continuous", "static"):
+                result[f"serve_{policy}_tokens_per_sec"] = (
+                    serve[policy]["tokens_per_sec"]
+                )
+                result[f"serve_{policy}_ttft_p95_ms"] = (
+                    serve[policy]["ttft_p95_ms"]
+                )
+            _log(f"serve: {serve}")
+        except Exception as exc:
+            _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
         try:
             longctx = suite.bench_long_context(tpu=True)
             # Fresh measurement replaces any carried-forward stale section.

@@ -541,6 +541,140 @@ def bench_decode(tpu: bool):
     }
 
 
+def bench_serve(tpu: bool):
+    """Online-serving throughput/TTFT: continuous batching (slot
+    scheduler, freed slots re-admitted next tick) vs static batching
+    (same slot grid, but admissions wait for the whole batch to drain)
+    under ONE seeded Poisson arrival trace. Same engine, same compiled
+    step program — the delta is purely the scheduling policy, which is
+    the number this bench exists to pin."""
+    import time
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.serving import SamplingParams, SlotScheduler
+
+    select_devices()
+    if tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            scan_layers=False,
+        )
+        n_requests, max_slots, mean_gap_s = 32, 8, 0.02
+        prompt_lens, max_new_range = (64, 128, 256), (32, 256)
+    else:
+        config = TransformerConfig.tiny(scan_layers=False, max_seq_len=64)
+        n_requests, max_slots, mean_gap_s = 12, 4, 0.005
+        prompt_lens, max_new_range = (5, 9, 14), (2, 16)
+    model = Transformer(config)
+    rng = np.random.RandomState(0)
+    params = nn.meta.unbox(
+        model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, max(prompt_lens)), jnp.int32),
+        )
+    )
+
+    # One seeded Poisson trace shared by both policies.
+    gaps = rng.exponential(mean_gap_s, n_requests)
+    arrivals = np.cumsum(gaps)
+    requests = [
+        (
+            float(arrivals[i]),
+            rng.randint(0, config.vocab_size,
+                        rng.choice(prompt_lens)).tolist(),
+            int(rng.randint(*max_new_range)),
+        )
+        for i in range(n_requests)
+    ]
+    total_tokens = sum(m for _, _, m in requests)
+
+    def run_policy(continuous: bool):
+        engine = DecodeEngine(model)
+        scheduler = SlotScheduler(
+            engine, params, max_slots=max_slots,
+            queue_capacity=n_requests,
+        )
+        scheduler.start()
+        try:
+            # Warmup: compile every prompt bucket's prefill + the step
+            # program outside the timed window (a warm server's steady
+            # state) — TTFT must measure scheduling, not XLA.
+            for length in prompt_lens:
+                scheduler.submit(
+                    [1] * length, SamplingParams(max_new_tokens=2)
+                ).result(timeout=300)
+            responses = []
+            t0 = time.perf_counter()
+            if continuous:
+                for offset, prompt, max_new in requests:
+                    lag = t0 + offset - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    responses.append((scheduler.submit(
+                        prompt, SamplingParams(max_new_tokens=max_new)
+                    ), offset))
+                for response, _ in responses:
+                    response.result(timeout=600)
+            else:
+                # Static batching: the next group is submitted only when
+                # the previous one fully drained — a freed slot idles.
+                for start in range(0, n_requests, max_slots):
+                    group = requests[start:start + max_slots]
+                    lag = t0 + group[-1][0] - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    batch = [
+                        (scheduler.submit(
+                            prompt, SamplingParams(max_new_tokens=max_new)
+                        ), offset)
+                        for offset, prompt, max_new in group
+                    ]
+                    for response, _ in batch:
+                        response.result(timeout=600)
+                    responses.extend(batch)
+            wall = time.perf_counter() - t0
+            # TTFT measured against the trace's arrival time, not the
+            # submit call — static batching's queue wait must count.
+            ttfts = sorted(
+                (response.first_token_at - t0) - offset
+                for response, offset in responses
+            )
+            return {
+                "tokens_per_sec": round(total_tokens / wall, 2),
+                "wall_s": round(wall, 3),
+                "ttft_mean_ms": round(
+                    1000 * sum(ttfts) / len(ttfts), 2),
+                "ttft_p95_ms": round(
+                    1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 2),
+                "step_compiles": engine.stats["step_compiles"],
+            }
+        finally:
+            scheduler.close()
+
+    continuous = run_policy(continuous=True)
+    static = run_policy(continuous=False)
+    speedup = (
+        round(continuous["tokens_per_sec"] / static["tokens_per_sec"], 3)
+        if static["tokens_per_sec"] else None
+    )
+    return {
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "total_tokens": total_tokens,
+        "continuous": continuous,
+        "static": static,
+        "continuous_vs_static_speedup": speedup,
+    }
+
+
 def bench_ici_allreduce(tpu: bool):
     from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -560,6 +694,7 @@ CONFIGS = {
     "llama_lora": bench_llama_lora,
     "long_context": bench_long_context,
     "decode": bench_decode,
+    "serve": bench_serve,
     "ici_allreduce": bench_ici_allreduce,
 }
 

@@ -111,9 +111,11 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
     instrumented = [
         "tf_yarn_tpu/telemetry",
         "tf_yarn_tpu/resilience",
+        "tf_yarn_tpu/serving",
         "tf_yarn_tpu/training.py",
         "tf_yarn_tpu/inference.py",
         "tf_yarn_tpu/models/decode_engine.py",
+        "tf_yarn_tpu/tasks/serving.py",
         "tf_yarn_tpu/checkpoint.py",
         "tf_yarn_tpu/client.py",
         "tf_yarn_tpu/coordination/kv.py",
@@ -235,6 +237,11 @@ def test_jaxpr_engine_default_entries_clean_on_this_build():
     # must be present (the on-device-EOS-loop contract).
     assert "models.decode_engine.prefill" in counts
     assert counts["models.decode_engine.decode_loop"]["while"] >= 1
+    # the continuous-batching slot step traced too: it runs once per
+    # generated token across the whole serving grid, so it is exactly
+    # where a smuggled host callback would hurt most.
+    assert "models.decode_engine.step" in counts
+    assert counts["models.decode_engine.step"]["dot_general"] > 0
 
 
 def test_finding_format_and_json_roundtrip():

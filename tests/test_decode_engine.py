@@ -19,6 +19,7 @@ from tf_yarn_tpu.models.decode_engine import (
     DecodeEngine,
     build_decode_fn,
     build_prefill_fn,
+    build_step_fn,
     clear_engines,
     get_engine,
 )
@@ -215,6 +216,160 @@ def test_generate_wrapper_routes_through_shared_engine():
     model_again = transformer.Transformer(model.config)
     assert get_engine(model_again) is get_engine(model)
     clear_engines()
+
+
+def test_oversized_batch_chunks_through_largest_bucket():
+    """Regression: a batch beyond the largest bucket used to silently
+    compile a one-off unbucketed program. Now it chunks through the
+    largest bucket: outputs stay identical to the legacy path (greedy
+    rows are independent) and NO unbucketed compile happens — every
+    compiled shape is a bucket."""
+    model, params = _model_and_params()
+    engine = _engine(model)  # batch buckets (2, 4): largest is 4
+    rng = np.random.RandomState(4)
+    prompt = jnp.asarray(rng.randint(0, 256, (10, 10)), jnp.int32)
+    out = engine.generate(params, prompt, 5, temperature=0.0)
+    ref = generate_legacy(model, params, prompt, 5, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert engine.stats["unbucketed_shapes"] == 0
+    assert engine.stats["oversize_batch_chunks"] == 1
+    # 10 rows -> chunks of 4, 4, 2: exactly the b=4 and b=2 bucket
+    # programs, and the repeated b=4 chunk hits the cache.
+    assert engine.stats["prefill_compiles"] == 2
+    assert engine.stats["prefill_cache_hits"] == 1
+
+
+def test_slot_step_grid_matches_legacy_per_request():
+    """The serving grid's device contract: slots admitted at different
+    times, prompt lengths, and seeds — advanced one token per compiled
+    `step` call — reproduce generate_legacy bit-for-bit per request,
+    including the sampled RNG chain (replay steps consume no RNG)."""
+    model, params = _model_and_params()
+    engine = _engine(model, batch_buckets=(1, 2, 4),
+                     prompt_buckets=(4, 8, 16))
+    slots = 3
+    grid = engine.make_slot_cache(params, slots)
+    rng_np = np.random.RandomState(5)
+    prompts = [
+        rng_np.randint(0, 256, (5,)).astype(np.int32),   # prefill 4, replay 1
+        rng_np.randint(0, 256, (9,)).astype(np.int32),   # prefill 8, replay 1
+        rng_np.randint(0, 256, (3,)).astype(np.int32),   # no prefill: replay 3
+    ]
+    seeds = [0, 7, 3]
+    max_new = 6
+    sampling = dict(temperature=1.0, top_k=8, top_p=0.9)
+
+    rngs = np.zeros((slots, 2), np.uint32)
+    pending, last, emitted_all = [], np.zeros((slots,), np.int32), []
+    for slot, (prompt, seed) in enumerate(zip(prompts, seeds)):
+        prefill_len = engine.slot_prefill_len(len(prompt))
+        if prefill_len > 0:
+            row, _ = engine.prefill(params, prompt[None, :prefill_len])
+            grid = engine.insert_slot(grid, slot, row)
+        else:
+            grid = engine.evict_slot(grid, slot)
+        pending.append(list(prompt[prefill_len:]))
+        rngs[slot] = np.asarray(jax.random.PRNGKey(seed))
+        emitted_all.append([])
+
+    for _ in range(max_new + max(len(p) for p in pending)):
+        tokens = np.zeros((slots,), np.int32)
+        mask = np.zeros((slots,), bool)
+        for slot in range(slots):
+            if len(emitted_all[slot]) >= max_new:
+                continue  # finished slot rides along masked off
+            if pending[slot]:
+                tokens[slot] = pending[slot][0]
+                mask[slot] = len(pending[slot]) == 1
+            else:
+                tokens[slot] = last[slot]
+                mask[slot] = True
+        if not mask.any():
+            break
+        grid, emitted, rngs_out = engine.step(
+            params, grid, tokens, rngs, mask, **sampling
+        )
+        emitted = np.asarray(emitted)
+        rngs = np.array(rngs_out)
+        for slot in range(slots):
+            if len(emitted_all[slot]) >= max_new:
+                continue
+            if pending[slot]:
+                sampled = len(pending[slot]) == 1
+                pending[slot].pop(0)
+                if not sampled:
+                    continue
+            emitted_all[slot].append(int(emitted[slot]))
+            last[slot] = emitted[slot]
+
+    for slot, (prompt, seed) in enumerate(zip(prompts, seeds)):
+        ref = generate_legacy(
+            model, params, prompt[None], max_new, seed=seed, **sampling
+        )
+        assert emitted_all[slot] == np.asarray(
+            ref
+        )[0, len(prompt):].tolist(), f"slot {slot}"
+    # One grid configuration = ONE compiled step program, reused.
+    assert engine.stats["step_compiles"] == 1
+    assert engine.stats["step_cache_hits"] >= max_new - 1
+
+
+def test_slot_step_traces_with_zero_host_syncs():
+    """Jaxpr twin for the serving step: no host-callback or transfer
+    primitive in the per-tick program."""
+    from tf_yarn_tpu.analysis.jaxpr_engine import (
+        _HOST_CALLBACK_PRIMITIVES,
+        _walk_jaxpr,
+    )
+
+    model, params = _model_and_params()
+    row = jax.eval_shape(
+        build_prefill_fn(model), params,
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),
+    )[0]
+    grid = jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct((2,) + leaf.shape, leaf.dtype), row
+    )
+    fn = build_step_fn(model, temperature=1.0, top_k=4, top_p=0.9)
+    closed = jax.make_jaxpr(fn)(
+        params, grid,
+        jax.ShapeDtypeStruct((2,), jnp.int32),
+        jax.ShapeDtypeStruct((2, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((2,), jnp.bool_),
+    )
+    prims = {eqn.primitive.name for eqn in _walk_jaxpr(closed.jaxpr)}
+    assert not prims & _HOST_CALLBACK_PRIMITIVES, sorted(
+        prims & _HOST_CALLBACK_PRIMITIVES
+    )
+
+
+def test_insert_and_evict_slot_splice():
+    """insert_slot installs a prefilled batch-1 cache (cache_index
+    included) at exactly one slot; evict_slot zeroes exactly one slot."""
+    model, params = _model_and_params()
+    engine = _engine(model, batch_buckets=(1, 2, 4),
+                     prompt_buckets=(4, 8, 16))
+    grid = engine.make_slot_cache(params, 2)
+    prompt = jnp.arange(8, dtype=jnp.int32)[None]
+    row, _logits = engine.prefill(params, prompt)
+    grid = engine.insert_slot(grid, 1, row)
+
+    leaves = jax.tree_util.tree_leaves_with_path(grid)
+    row_leaves = dict(
+        (jax.tree_util.keystr(path), value)
+        for path, value in jax.tree_util.tree_leaves_with_path(row)
+    )
+    for path, leaf in leaves:
+        expected = row_leaves[jax.tree_util.keystr(path)]
+        np.testing.assert_array_equal(
+            np.asarray(leaf[1]), np.asarray(expected)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(leaf[0]), np.zeros_like(np.asarray(expected))
+        )
+    grid = engine.evict_slot(grid, 1)
+    for _path, leaf in jax.tree_util.tree_leaves_with_path(grid):
+        assert not np.asarray(leaf).any()
 
 
 def test_engine_validates_like_generate():

@@ -263,6 +263,34 @@ def test_tokens_per_sec_excludes_eos_padding(tmp_path, monkeypatch):
     assert record["tokens"] == [eos] * 6
 
 
+def test_pipeline_depths_are_validated_fields():
+    """prefetch_depth/writer_depth are real validated fields now (not
+    getattr duck-typing): invalid values fail at construction, and the
+    runner-side check still covers duck-typed experiment objects."""
+    from tf_yarn_tpu import inference as inference_mod
+
+    for field in ("prefetch_depth", "writer_depth"):
+        with pytest.raises(ValueError, match=field):
+            InferenceExperiment(
+                model=None,
+                model_dir="x",
+                input_fn=lambda: iter(()),
+                output_path="y",
+                **{field: 0},
+            )
+    # Backward compatibility: objects without the fields get defaults...
+    class _Duck:
+        pass
+
+    assert inference_mod._pipeline_depth(_Duck(), "prefetch_depth", 2) == 2
+    assert inference_mod._pipeline_depth(_Duck(), "writer_depth", 8) == 8
+    # ...but an explicitly invalid duck-typed value still fails loudly.
+    duck = _Duck()
+    duck.writer_depth = 0
+    with pytest.raises(ValueError, match="writer_depth"):
+        inference_mod._pipeline_depth(duck, "writer_depth", 8)
+
+
 def test_writer_error_propagates(tmp_path, monkeypatch):
     """A failing input stream must not deadlock the bounded writer."""
     model, _variables = _init_model(monkeypatch)

@@ -12,6 +12,7 @@ from typing import Optional
 WORKER_MODULE = "tf_yarn_tpu.tasks.worker"
 TENSORBOARD_MODULE = "tf_yarn_tpu.tasks.tensorboard"
 EVALUATOR_MODULE = "tf_yarn_tpu.tasks.evaluator"
+SERVING_MODULE = "tf_yarn_tpu.tasks.serving"
 
 
 def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) -> str:
@@ -19,4 +20,6 @@ def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) ->
         return TENSORBOARD_MODULE
     if task_type == "evaluator":
         return EVALUATOR_MODULE
+    if task_type == "serving":
+        return custom_task_module or SERVING_MODULE
     return custom_task_module or WORKER_MODULE

@@ -462,9 +462,47 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return fn, args, {}
 
+    def step():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            build_prefill_fn,
+            build_step_fn,
+        )
+
+        model, params, _prompt, _cache = _engine_avals()
+        # The slot grid: each slot is a batch-1 cache stacked along a new
+        # leading axis (DecodeEngine.make_slot_cache), so slots sit at
+        # independent cache_index positions.
+        row = jax.eval_shape(
+            build_prefill_fn(model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0]
+        slots = 2
+        grid = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (slots,) + leaf.shape, leaf.dtype
+            ),
+            row,
+        )
+        fn = build_step_fn(model, temperature=0.0, top_k=None, top_p=None)
+        args = (
+            params, grid,
+            jax.ShapeDtypeStruct((slots,), jnp.int32),   # tokens
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),  # per-slot rngs
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),   # sample mask
+        )
+        return fn, args, {}
+
     return [
         EntryPoint("models.decode_engine.prefill", prefill),
         EntryPoint("models.decode_engine.decode_loop", decode_loop),
+        # The serving tick's device program (continuous batching): runs
+        # once per generated token across the whole slot grid, so a host
+        # callback smuggled in here is a per-token round-trip for every
+        # in-flight request at once.
+        EntryPoint("models.decode_engine.step", step),
     ]
 
 

@@ -297,6 +297,16 @@ def _execute_and_await_termination(
             (url_event_name(key.to_kv_str()), "tensorboard URL")
             for key in cluster.handle.tasks()
             if key.type == "tensorboard"
+        ]
+        # Serving replicas advertise their HTTP endpoint the same way
+        # (tf_yarn_tpu.serving): surface each once in the driver log.
+        + [
+            (
+                event.serving_endpoint_event_name(key.to_kv_str()),
+                "serving endpoint",
+            )
+            for key in cluster.handle.tasks()
+            if key.type == "serving"
         ],
         n_try,
     )

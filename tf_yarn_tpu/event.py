@@ -44,6 +44,10 @@ HEARTBEAT = "heartbeat"
 # like a growing heartbeat age.
 HEARTBEAT_STOPPED = "heartbeat.stopped"
 METRICS = "metrics"
+# Online-serving discovery (tf_yarn_tpu.serving): each serving task
+# advertises its HTTP endpoint so clients and the driver find it
+# through the KV store instead of guessing ports.
+SERVING_ENDPOINT = "serving_endpoint"
 
 
 def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
@@ -126,6 +130,17 @@ def heartbeat_stopped_event(
     never as dead."""
     ts = time.time() if timestamp is None else timestamp
     broadcast(kv, f"{task}/{HEARTBEAT_STOPPED}", f"{ts:.3f}")
+
+
+def serving_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
+    """Advertise a serving task's HTTP endpoint (``host:port``) for
+    discovery: clients read ``{task}/serving_endpoint`` instead of
+    guessing ports, and the driver logs it once at launch."""
+    broadcast(kv, f"{task}/{SERVING_ENDPOINT}", endpoint)
+
+
+def serving_endpoint_event_name(task: str) -> str:
+    return f"{task}/{SERVING_ENDPOINT}"
 
 
 def metrics_event(kv: KVStore, task: str, payload: str) -> None:

@@ -259,11 +259,65 @@ class InferenceExperiment:
     # Multi-instance jobs whose input_fn ignores (shard, num_shards) fail
     # fast unless duplication of the full stream is explicitly intended.
     allow_duplicate_stream: bool = False
-    # Pipeline depths (inference.run_inference): input batches staged
-    # ahead of the device, and decoded batches queued to the background
-    # JSONL writer before the producer blocks.
+    # Pipeline depths (inference.run_inference): `prefetch_depth` input
+    # batches staged ahead of the device, and `writer_depth` decoded
+    # batches queued to the background JSONL writer before the producer
+    # blocks. Both >= 1 (validated at construction — a 0 would silently
+    # serialize the pipeline stage instead of disabling it).
     prefetch_depth: int = 2
     writer_depth: int = 8
+
+    def __post_init__(self) -> None:
+        for name in ("prefetch_depth", "writer_depth"):
+            value = getattr(self, name)
+            if value < 1:
+                raise ValueError(f"{name} must be >= 1, got {value}")
+        if self.max_new_tokens < 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {self.max_new_tokens}"
+            )
+
+
+@dataclasses.dataclass
+class ServingExperiment:
+    """Online-serving job: load a checkpoint, serve ``/v1/generate``
+    with continuous batching until stopped (tf_yarn_tpu/serving/,
+    docs/Serving.md). The online counterpart of InferenceExperiment —
+    same restore path, but requests arrive over HTTP into a bounded
+    admission queue and decode on a fixed grid of ``max_slots``
+    persistent KV slots instead of as whole-stream batches.
+
+    ``temperature``/``top_k``/``top_p`` configure the ONE compiled
+    slot-step program; requests carrying different values are rejected
+    with a 400 (per-request ``max_new_tokens``/``seed``/``eos_token``
+    stay free). ``serve_seconds=None`` serves until the task is killed
+    or a preemption notice arrives (the normal production posture).
+    """
+
+    model: Any
+    model_dir: str
+    host: str = "0.0.0.0"
+    port: int = 0  # 0 = ephemeral; the bound port is advertised via KV
+    max_slots: int = 8
+    queue_capacity: int = 64
+    retry_after_s: float = 1.0
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    step: Optional[int] = None  # checkpoint step; None = latest
+    serve_seconds: Optional[float] = None
+
+    def __post_init__(self) -> None:
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if self.queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1, got {self.queue_capacity}"
+            )
+        if self.serve_seconds is not None and self.serve_seconds <= 0:
+            raise ValueError(
+                f"serve_seconds must be > 0 or None, got {self.serve_seconds}"
+            )
 
 
 @dataclasses.dataclass
@@ -350,7 +404,8 @@ def as_core_experiment(experiment: Any) -> CoreExperiment:
 
 
 EXPERIMENT_TYPES = (
-    JaxExperiment, ExperimentSpec, KerasExperiment, InferenceExperiment
+    JaxExperiment, ExperimentSpec, KerasExperiment, InferenceExperiment,
+    ServingExperiment,
 )
 
 
@@ -369,6 +424,11 @@ def run_experiment(runtime, experiment: Any) -> None:
                 from tf_yarn_tpu import inference
 
                 inference.run_inference(experiment, runtime=runtime)
+                return
+            if isinstance(experiment, ServingExperiment):
+                from tf_yarn_tpu.serving.server import run_serving
+
+                run_serving(experiment, runtime=runtime)
                 return
             from tf_yarn_tpu import training
 

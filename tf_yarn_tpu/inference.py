@@ -76,6 +76,18 @@ def _call_input_fn(input_fn, shard: int, num_shards: int):
     return input_fn()
 
 
+def _pipeline_depth(experiment, name: str, default: int) -> int:
+    """Pipeline-depth knob as a validated int. `InferenceExperiment`
+    carries these as real validated fields; the getattr default keeps
+    duck-typed experiment objects (tests, user shims predating the
+    fields) working — but an explicit invalid value fails loudly here
+    instead of silently wedging a queue."""
+    value = int(getattr(experiment, name, default))
+    if value < 1:
+        raise ValueError(f"{name} must be >= 1, got {value}")
+    return value
+
+
 def _restore_params(model_dir: str, step: Optional[int]):
     """Host-restore the checkpointed TrainState and keep its params:
     topology-independent (restore_checkpoint_host), so an inference job
@@ -237,7 +249,7 @@ def run_inference(experiment, runtime=None) -> dict:
     with io.TextIOWrapper(fs_lib.open_output(out_path), encoding="utf-8") as out:
         writer = _JsonlWriter(
             out, experiment.eos_token,
-            depth=getattr(experiment, "writer_depth", 8),
+            depth=_pipeline_depth(experiment, "writer_depth", 8),
         )
         try:
             # Stage 1: input batches staged ahead on a background thread;
@@ -246,7 +258,7 @@ def run_inference(experiment, runtime=None) -> dict:
             # wait for the decode to finish.
             stream = prefetch(
                 _call_input_fn(experiment.input_fn, shard, num_shards),
-                depth=getattr(experiment, "prefetch_depth", 2),
+                depth=_pipeline_depth(experiment, "prefetch_depth", 2),
                 name="inference",
             )
             while True:

@@ -183,6 +183,50 @@ def build_decode_fn(model, temperature: float, top_k: Optional[int],
     return decode
 
 
+def build_step_fn(model, temperature: float, top_k: Optional[int],
+                  top_p: Optional[float]):
+    """The continuous-batching slot step, shared by the engine and the
+    analysis jaxpr entry point (`models.decode_engine.step`).
+
+        fn(params, slot_cache, tokens, rngs, sample_mask)
+            -> (slot_cache, emitted [S], rngs)
+
+    ONE compiled program advances EVERY slot of a serving grid by one
+    token. `slot_cache` is the per-slot KV grid (leading slot axis; each
+    element a batch-1 decode cache with its own `cache_index`, so slots
+    sit at independent positions — the per-slot offsets the shared batch
+    cache of `decode_loop` cannot express). `tokens` [S] are this tick's
+    inputs: a forced prompt token while a slot replays its prompt
+    remainder, else the slot's last emitted token. `sample_mask` [S] is
+    the traced active mask: masked-off slots (free, or mid-replay) run
+    the same device program — the KV append is the point for replay
+    slots, garbage for free ones — but consume no RNG and pass their
+    input token through, so each slot's split chain stays bit-aligned
+    with generate_legacy's one-split-per-sample. The step that consumes
+    a request's LAST prompt token has sample_mask on: its output is the
+    first generated token, sampled with the first split — exactly
+    generate_legacy's prefill sample.
+    """
+
+    def step(params, slot_cache, tokens, rngs, sample_mask):
+        def one_slot(cache, token, rng, do_sample):
+            logits, state = model.apply(
+                {**params, "cache": cache}, token[None, None], decode=True,
+                mutable=["cache"],
+            )
+            next_rng, sample_key = jax.random.split(rng)
+            sampled = _sample(
+                logits[:, -1], sample_key, temperature, top_k, top_p
+            )[0]
+            emitted = jnp.where(do_sample, sampled, token)
+            rng = jnp.where(do_sample, next_rng, rng)
+            return state["cache"], emitted, rng
+
+        return jax.vmap(one_slot)(slot_cache, tokens, rngs, sample_mask)
+
+    return step
+
+
 def _ceil_bucket(value: int, buckets: Tuple[int, ...]) -> Optional[int]:
     for b in sorted(buckets):
         if b >= value:
@@ -226,15 +270,41 @@ class DecodeEngine:
         self._rest_width = max(gaps) if gaps else 1
         self._prefill: Dict[tuple, Any] = {}
         self._decode: Dict[tuple, Any] = {}
+        self._step: Dict[tuple, Any] = {}
         self._lock = threading.Lock()
         self.stats = {
             "calls": 0,
             "prefill_compiles": 0,
             "decode_compiles": 0,
+            "step_compiles": 0,
             "prefill_cache_hits": 0,
             "decode_cache_hits": 0,
+            "step_cache_hits": 0,
             "unbucketed_shapes": 0,
+            "oversize_batch_chunks": 0,
         }
+
+        # Slot-grid splice helpers (continuous batching): donated, so the
+        # grid updates HBM in place instead of copying the whole KV store
+        # per admission/retirement.
+        def _insert(grid, row, slot):
+            return jax.tree_util.tree_map(
+                lambda buf, r: jax.lax.dynamic_update_index_in_dim(
+                    buf, r.astype(buf.dtype), slot, 0
+                ),
+                grid, row,
+            )
+
+        def _evict(grid, slot):
+            return jax.tree_util.tree_map(
+                lambda buf: jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.zeros(buf.shape[1:], buf.dtype), slot, 0
+                ),
+                grid,
+            )
+
+        self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+        self._evict_jit = jax.jit(_evict, donate_argnums=(0,))
 
     # -- bucket selection --------------------------------------------------
 
@@ -292,6 +362,117 @@ class DecodeEngine:
             )
         return compiled
 
+    def _compiled_prefill(self, params, prompt, fp):
+        """(cache, last-position logits) through the compile cache; the
+        exact [B, F] shape keys the cache — callers pick bucketed
+        shapes."""
+        b, f = prompt.shape
+        prefill_key = (b, f, fp)
+        prefill_fn = build_prefill_fn(self.model)
+        prefill_args = (params, prompt)
+        compiled = self._compiled(
+            self._prefill, prefill_key, "prefill",
+            lambda: jax.jit(prefill_fn).lower(*prefill_args).compile(),
+        )
+        # Dispatch-side span: async device futures, so this times the
+        # enqueue (host cost), not the device compute — the XLA profiler
+        # owns the device side.
+        with telemetry.span("decode_engine/prefill", batch=b, prompt=f):
+            return compiled(*prefill_args)
+
+    # -- continuous-batching slot API --------------------------------------
+    #
+    # The serving scheduler (tf_yarn_tpu/serving/scheduler.py) keeps a
+    # fixed grid of `max_slots` decode slots, each backed by a persistent
+    # batch-1 KV cache with its own cache_index. Admission prefills a
+    # request's prompt through the SAME bucketed prefill programs
+    # `generate` uses and splices the result into a free slot; every tick
+    # then advances all slots one token in one compiled `step` program.
+
+    def slot_prefill_len(self, prompt_len: int) -> int:
+        """Prefill length for a slot admission: the largest prompt bucket
+        that still leaves >= 1 prompt token to replay through `step` (the
+        step consuming the LAST prompt token samples the first generated
+        token — generate_legacy's prefill sample — so the final prompt
+        position always goes through the step program). 0 = no prefill:
+        the whole prompt replays token-by-token from an empty slot."""
+        if prompt_len <= 1:
+            return 0
+        return _floor_bucket(prompt_len - 1, self.prompt_buckets) or 0
+
+    def prefill(self, params, prompt):
+        """Public compiled prefill: [B, F] prompt -> (cache, last
+        logits). B/F key the compile cache directly."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        prompt = jnp.asarray(prompt, jnp.int32)
+        return self._compiled_prefill(
+            params, prompt, self._params_fingerprint(params)
+        )
+
+    def make_slot_cache(self, params, max_slots: int):
+        """Zeroed per-slot KV grid: every leaf of the model's decode
+        cache stacked along a new leading slot axis (batch-1 per slot,
+        per-slot cache_index). Shapes come from an abstract prefill —
+        nothing runs on the device except the zeros allocation."""
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        cache_avals = jax.eval_shape(
+            build_prefill_fn(self.model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0]
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros((max_slots,) + leaf.shape, leaf.dtype),
+            cache_avals,
+        )
+
+    def insert_slot(self, slot_cache, slot: int, row_cache):
+        """Splice a freshly prefilled batch-1 cache (cache_index
+        included) into slot `slot`. The grid is donated: HBM updates in
+        place. The old grid reference is consumed — use the return."""
+        return self._insert_jit(
+            slot_cache, row_cache, jnp.asarray(slot, jnp.int32)
+        )
+
+    def evict_slot(self, slot_cache, slot: int):
+        """Zero slot `slot` (KV content and cache_index), returning the
+        donated grid. Freeing is host-side bookkeeping — this exists so
+        a retired slot's stale cache can never leak into a later
+        admission path that skips prefill (slot_prefill_len == 0)."""
+        return self._evict_jit(slot_cache, jnp.asarray(slot, jnp.int32))
+
+    def step(
+        self,
+        params,
+        slot_cache,
+        tokens,
+        rngs,
+        sample_mask,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        """Advance every slot of the grid one token in ONE compiled
+        program (build_step_fn). Compiled once per (grid size, sampling
+        config, params fingerprint); the KV grid and the per-slot rng
+        buffer are donated. Returns (slot_cache, emitted [S], rngs)."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        rngs = jnp.asarray(rngs, jnp.uint32)
+        sample_mask = jnp.asarray(sample_mask, bool)
+        fp = self._params_fingerprint(params)
+        slots = int(tokens.shape[0])
+        step_key = (slots, float(temperature), top_k, top_p, fp)
+        step_fn = build_step_fn(self.model, temperature, top_k, top_p)
+        step_args = (params, slot_cache, tokens, rngs, sample_mask)
+        compiled = self._compiled(
+            self._step, step_key, "step",
+            lambda: jax.jit(step_fn, donate_argnums=(1, 3))
+            .lower(*step_args).compile(),
+        )
+        with telemetry.span("decode_engine/step", slots=slots):
+            return compiled(*step_args)
+
     # -- the public entry point --------------------------------------------
 
     def generate(
@@ -317,6 +498,34 @@ class DecodeEngine:
             )
         if max_new_tokens == 0:
             return prompt
+        max_batch = self.batch_buckets[-1] if self.batch_buckets else None
+        if max_batch is not None and b > max_batch:
+            # Chunk through the largest bucket instead of compiling a
+            # one-off unbucketed program for every oversized batch size.
+            # Greedy outputs are identical either way (rows are
+            # independent); at temperature > 0 each chunk draws from its
+            # own seed-`seed` chain, matching a direct call on that
+            # chunk — the same documented caveat batch padding already
+            # carries (categorical noise is shaped by the device batch).
+            with self._lock:
+                self.stats["oversize_batch_chunks"] += 1
+            telemetry.get_registry().counter(
+                "decode_engine/oversize_batch_chunks"
+            ).inc()
+            _logger.info(
+                "decode-engine: batch %d exceeds largest bucket %d — "
+                "chunking into %d calls", b, max_batch,
+                -(-b // max_batch),
+            )
+            chunks = [
+                self.generate(
+                    params, prompt[i:i + max_batch], max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed, eos_token=eos_token,
+                )
+                for i in range(0, b, max_batch)
+            ]
+            return jnp.concatenate(chunks, axis=0)
         params = jax.tree_util.tree_map(jnp.asarray, params)
         fp = self._params_fingerprint(params)
         with self._lock:
@@ -347,20 +556,9 @@ class DecodeEngine:
         has_rest = rest_len > 0
         has_eos = eos_token is not None
 
-        prefill_key = (b_bucket, f, fp)
-        prefill_fn = build_prefill_fn(self.model)
-        prefill_args = (params, prompt_padded[:, :f])
-        compiled_prefill = self._compiled(
-            self._prefill, prefill_key, "prefill",
-            lambda: jax.jit(prefill_fn).lower(*prefill_args).compile(),
+        cache, last_logits = self._compiled_prefill(
+            params, prompt_padded[:, :f], fp
         )
-        # Dispatch-side spans: async device futures, so these time the
-        # enqueue (host cost), not the device compute — the XLA profiler
-        # owns the device side.
-        with telemetry.span(
-            "decode_engine/prefill", batch=b_bucket, prompt=f
-        ):
-            cache, last_logits = compiled_prefill(*prefill_args)
 
         t_max = -(-max_new_tokens // self.token_bucket) * self.token_bucket
         out0 = jnp.full(

@@ -1,0 +1,53 @@
+"""Online serving: continuous batching over the compiled decode engine.
+
+The subsystem that turns the offline `run_inference` stack into an
+online server (docs/Serving.md):
+
+* :mod:`~tf_yarn_tpu.serving.request` — Request/Response lifecycle, the
+  bounded admission queue with backpressure, per-request deadlines.
+* :mod:`~tf_yarn_tpu.serving.scheduler` — the slot scheduler: a fixed
+  grid of persistent per-slot KV caches, one compiled device step per
+  tick, free-list slot reuse (continuous, not static, batching).
+* :mod:`~tf_yarn_tpu.serving.server` — the threaded stdlib HTTP
+  frontend (``/v1/generate``, ``/healthz``, ``/stats``) and
+  `run_serving`, the body of the ``serving`` task type.
+
+Launch through :func:`tf_yarn_tpu.client.run_on_tpu` with a
+``ServingExperiment`` and a ``serving`` task spec
+(`topologies.serving_topology`); the task advertises its endpoint in
+the coordination KV store for discovery.
+"""
+
+from tf_yarn_tpu.serving.request import (  # noqa: F401
+    FINISH_DEADLINE,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_SHUTDOWN,
+    AdmissionQueue,
+    QueueFull,
+    Request,
+    Response,
+    SamplingParams,
+)
+from tf_yarn_tpu.serving.scheduler import SlotScheduler  # noqa: F401
+from tf_yarn_tpu.serving.server import (  # noqa: F401
+    ServingServer,
+    advertised_endpoint,
+    run_serving,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "FINISH_DEADLINE",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "FINISH_SHUTDOWN",
+    "QueueFull",
+    "Request",
+    "Response",
+    "SamplingParams",
+    "ServingServer",
+    "SlotScheduler",
+    "advertised_endpoint",
+    "run_serving",
+]

@@ -1,0 +1,219 @@
+"""Request lifecycle for the online serving subsystem.
+
+The user-visible half of continuous batching (docs/Serving.md): a
+:class:`Request` describes one generation (prompt ids, sampling params,
+optional deadline, priority), a :class:`Response` streams its tokens
+back as they are generated, and the :class:`AdmissionQueue` is the
+bounded front door — full means *reject now with a retry-after hint*,
+not buffer unboundedly until the process OOMs (the backpressure posture
+VirtualFlow argues for: the user-visible batch is decoupled from the
+hardware-resident batch, and the coupling point must be explicit).
+
+Everything here is host-side plumbing with no device or jax dependency;
+the scheduler (serving/scheduler.py) is the only consumer of the
+producer-side hooks (`_push`/`_finish`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional, Tuple
+
+# finish_reason values a Response can end with.
+FINISH_EOS = "eos"            # the model emitted the request's eos token
+FINISH_LENGTH = "length"      # max_new_tokens generated
+FINISH_DEADLINE = "deadline"  # per-request deadline hit (queued or active)
+FINISH_SHUTDOWN = "shutdown"  # scheduler closed with the request in flight
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request generation knobs.
+
+    `temperature`/`top_k`/`top_p` are baked into the compiled slot-step
+    program, so the scheduler serves ONE sampling configuration per
+    grid and rejects mismatching requests at admission (a 400, not a
+    recompile storm); `max_new_tokens`, `seed` and `eos_token` are free
+    per request.
+    """
+
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+    eos_token: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+
+
+class QueueFull(Exception):
+    """Admission rejected: the bounded queue is at capacity. Carries the
+    retry-after hint the HTTP frontend surfaces as a 429 Retry-After."""
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(
+            f"admission queue full ({depth} queued); retry in "
+            f"~{retry_after_s:.1f}s"
+        )
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `timeout_s` becomes an absolute monotonic
+    deadline at construction: it bounds the WHOLE lifetime (queue wait
+    included), and the scheduler cancels the request — queued or mid-
+    decode — once it passes."""
+
+    prompt: Tuple[int, ...]
+    params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    priority: int = 0
+    timeout_s: Optional[float] = None
+    id: int = dataclasses.field(default_factory=lambda: next(_REQUEST_IDS))
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    def __post_init__(self) -> None:
+        self.prompt = tuple(int(t) for t in self.prompt)
+        if not self.prompt:
+            raise ValueError("prompt must contain at least one token")
+        if self.timeout_s is not None and self.timeout_s <= 0:
+            raise ValueError(
+                f"timeout_s must be > 0, got {self.timeout_s}"
+            )
+
+    @property
+    def deadline(self) -> Optional[float]:
+        if self.timeout_s is None:
+            return None
+        return self.submitted_at + self.timeout_s
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        deadline = self.deadline
+        if deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= deadline
+
+
+_DONE = object()
+
+
+class Response:
+    """Consumer handle for one request: a per-token stream plus a final
+    result. Single-consumer: either iterate :meth:`tokens` (streaming)
+    or call :meth:`result` (blocking) — the token list accumulates
+    either way."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self._stream: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self.first_token_at: Optional[float] = None
+
+    # -- producer side (the scheduler thread) ------------------------------
+
+    def _push(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self._tokens.append(int(token))
+        self._stream.put(int(token))
+
+    def _finish(self, reason: str) -> None:
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self._done.set()
+        self._stream.put(_DONE)
+
+    # -- consumer side ------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def tokens(self) -> Iterator[int]:
+        """Yield tokens as the scheduler emits them; returns when the
+        request finishes (check `finish_reason` afterwards)."""
+        while True:
+            item = self._stream.get()
+            if item is _DONE:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; the generated tokens."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.id} not finished after {timeout}s"
+            )
+        return list(self._tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time-to-first-token, once one exists."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.request.submitted_at
+
+
+class AdmissionQueue:
+    """Bounded priority admission queue.
+
+    `submit` raises :class:`QueueFull` at capacity — backpressure is the
+    caller's signal to shed or retry, never silent buffering. Ordering
+    is (priority desc, arrival order); `retry_after_s` is a static hint
+    the frontend turns into an HTTP Retry-After header.
+    """
+
+    def __init__(self, capacity: int = 64, retry_after_s: float = 1.0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.retry_after_s = retry_after_s
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[int, int, Request, Response]] = []
+        self._seq = itertools.count()
+
+    def submit(self, request: Request) -> Response:
+        response = Response(request)
+        with self._lock:
+            if len(self._heap) >= self.capacity:
+                raise QueueFull(len(self._heap), self.retry_after_s)
+            heapq.heappush(
+                self._heap,
+                (-request.priority, next(self._seq), request, response),
+            )
+        return response
+
+    def pop(self) -> Optional[Tuple[Request, Response]]:
+        with self._lock:
+            if not self._heap:
+                return None
+            _, _, request, response = heapq.heappop(self._heap)
+            return request, response
+
+    def drain(self) -> List[Tuple[Request, Response]]:
+        with self._lock:
+            items = [(req, resp) for _, _, req, resp in self._heap]
+            self._heap.clear()
+            return items
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)

@@ -1,0 +1,375 @@
+"""Continuous-batching slot scheduler over the compiled decode engine.
+
+The device-facing half of the serving subsystem (docs/Serving.md): a
+fixed grid of ``max_slots`` decode slots, each backed by a persistent
+batch-1 KV cache (`DecodeEngine.make_slot_cache`). Every scheduler tick:
+
+1. **retire** active slots whose per-request deadline passed;
+2. **admit** queued requests into free slots — prefill the prompt
+   through the engine's existing bucketed prefill programs
+   (`slot_prefill_len` picks the largest bucket that leaves the last
+   prompt token for the step program), splice the prefilled KV into the
+   slot (`insert_slot`), and queue the prompt remainder for replay;
+3. **step** ALL slots one token in ONE compiled program
+   (`DecodeEngine.step`): replaying slots force their next prompt token
+   (no RNG consumed — the split chain stays bit-aligned with
+   `generate_legacy`), emitting slots feed back their last token, free
+   slots ride along masked off;
+4. **retire** slots that emitted their eos or hit max_new_tokens,
+   pushing their slot back on the free-list — reusable on the very next
+   tick, so decode work for in-flight requests never waits for a batch
+   to drain (continuous batching, not static batching).
+
+The scheduler is a pure host-side state machine: its only device
+contract is the engine's five slot methods (make_slot_cache / prefill /
+insert_slot / evict_slot / step), so the unit tests drive it with a
+fake engine and assert the tick-by-tick trace deterministically.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.serving.request import (
+    FINISH_DEADLINE,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_SHUTDOWN,
+    AdmissionQueue,
+    Request,
+    Response,
+    SamplingParams,
+)
+
+_logger = logging.getLogger(__name__)
+
+# How long the scheduler loop sleeps between ticks when nothing is
+# active or queued; a submit wakes it immediately, so this only bounds
+# deadline-expiry latency for queued-but-idle states.
+IDLE_POLL_S = 0.05
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = ("request", "response", "pending", "last_token", "emitted")
+
+    def __init__(self, request: Request, response: Response,
+                 pending: List[int]):
+        self.request = request
+        self.response = response
+        # Prompt tokens still to replay through the step program; the
+        # LAST one's step output is the first generated token.
+        self.pending: Deque[int] = collections.deque(pending)
+        self.last_token = 0
+        self.emitted = 0
+
+
+class SlotScheduler:
+    """Continuous batching over a fixed slot grid (module docstring).
+
+    `temperature`/`top_k`/`top_p` configure the ONE compiled step
+    program the grid runs; requests whose SamplingParams disagree are
+    rejected at submit with ValueError (the HTTP frontend's 400).
+    """
+
+    def __init__(
+        self,
+        engine,
+        params,
+        max_slots: int = 8,
+        *,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        queue_capacity: int = 64,
+        retry_after_s: float = 1.0,
+        trace_len: int = 4096,
+    ):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.engine = engine
+        self.params = params
+        self.max_slots = max_slots
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.queue = AdmissionQueue(queue_capacity, retry_after_s)
+        self._cache = engine.make_slot_cache(params, max_slots)
+        self._rngs = np.zeros((max_slots, 2), np.uint32)
+        self._slots: List[Optional[_Slot]] = [None] * max_slots
+        self._free: Deque[int] = collections.deque(range(max_slots))
+        self._used_before = [False] * max_slots
+        self.trace: Deque[Dict] = collections.deque(maxlen=trace_len)
+        self._ticks = 0
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registry = telemetry.get_registry()
+        # max context the model's KV cache can hold, when the engine
+        # exposes a config (the fake engines in tests need not).
+        self._max_seq_len = getattr(
+            getattr(engine, "model", None), "config", None
+        )
+        self._max_seq_len = getattr(self._max_seq_len, "max_seq_len", None)
+
+    # -- submission (any thread) -------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        params: Optional[SamplingParams] = None,
+        priority: int = 0,
+        timeout_s: Optional[float] = None,
+    ) -> Response:
+        """Admit one request; returns its streaming Response. Raises
+        ValueError for requests this grid cannot serve and QueueFull when
+        the bounded queue is at capacity (backpressure)."""
+        params = params or SamplingParams(
+            temperature=self.temperature, top_k=self.top_k, top_p=self.top_p
+        )
+        if (params.temperature, params.top_k, params.top_p) != (
+            self.temperature, self.top_k, self.top_p,
+        ):
+            raise ValueError(
+                "this serving grid runs temperature="
+                f"{self.temperature}, top_k={self.top_k}, "
+                f"top_p={self.top_p}; per-request sampling overrides are "
+                "not supported (the config is baked into the compiled "
+                "step program)"
+            )
+        request = Request(
+            prompt=tuple(prompt), params=params, priority=priority,
+            timeout_s=timeout_s,
+        )
+        if self._max_seq_len is not None and (
+            len(request.prompt) + params.max_new_tokens > self._max_seq_len
+        ):
+            raise ValueError(
+                f"prompt ({len(request.prompt)}) + max_new_tokens "
+                f"({params.max_new_tokens}) exceeds the model's "
+                f"max_seq_len ({self._max_seq_len}) — the slot KV size"
+            )
+        try:
+            response = self.queue.submit(request)
+        except Exception:
+            self._registry.counter("serving/requests_rejected_total").inc()
+            raise
+        self._registry.counter("serving/requests_total").inc()
+        self._registry.gauge("serving/queue_depth").set(self.queue.depth)
+        self._work.set()
+        return response
+
+    # -- the tick (scheduler thread) ----------------------------------------
+
+    def tick(self) -> bool:
+        """One scheduling round; returns whether any work happened (the
+        loop idles when it returns False)."""
+        now = time.monotonic()
+        admitted: List[int] = []
+        retired: List = []
+        with telemetry.span("serving/tick") as tick_span:
+            with telemetry.span("serving/retire"):
+                self._retire_deadlines(now, retired)
+            with telemetry.span("serving/admit"):
+                self._admit(now, admitted)
+            active = [s for s in range(self.max_slots) if self._slots[s]]
+            if active:
+                with telemetry.span("serving/step", active=len(active)):
+                    self._step(active, retired)
+        worked = bool(active or admitted or retired)
+        if worked:
+            self._ticks += 1
+            self._registry.histogram("serving/tick_seconds").observe(
+                tick_span.duration
+            )
+            self._registry.counter("serving/ticks_total").inc()
+            self.trace.append({
+                "tick": self._ticks,
+                "admitted": admitted,
+                "retired": [(rid, reason) for rid, reason in retired],
+                "active": len([s for s in self._slots if s is not None]),
+                "queued": self.queue.depth,
+            })
+        self._registry.gauge("serving/active_slots").set(
+            len([s for s in self._slots if s is not None])
+        )
+        self._registry.gauge("serving/free_slots").set(len(self._free))
+        self._registry.gauge("serving/queue_depth").set(self.queue.depth)
+        return worked
+
+    def _retire_deadlines(self, now: float, retired: List) -> None:
+        for slot in range(self.max_slots):
+            state = self._slots[slot]
+            if state is not None and state.request.expired(now):
+                self._retire(slot, FINISH_DEADLINE, retired)
+
+    def _admit(self, now: float, admitted: List[int]) -> None:
+        while self._free:
+            item = self.queue.pop()
+            if item is None:
+                break
+            request, response = item
+            if request.expired(now):
+                # Died in the queue: never occupies a slot.
+                response._finish(FINISH_DEADLINE)
+                self._registry.counter(
+                    "serving/requests_completed_total", reason=FINISH_DEADLINE
+                ).inc()
+                continue
+            slot = self._free.popleft()
+            self._registry.histogram("serving/queue_wait_seconds").observe(
+                now - request.submitted_at
+            )
+            if self._used_before[slot]:
+                self._registry.counter("serving/slot_reuse_total").inc()
+            self._used_before[slot] = True
+            prefill_len = self.engine.slot_prefill_len(len(request.prompt))
+            with telemetry.span(
+                "serving/prefill", request=request.id, prefill=prefill_len
+            ):
+                if prefill_len > 0:
+                    row_cache, _logits = self.engine.prefill(
+                        self.params,
+                        np.asarray(request.prompt[:prefill_len],
+                                   np.int32)[None, :],
+                    )
+                    self._cache = self.engine.insert_slot(
+                        self._cache, slot, row_cache
+                    )
+                else:
+                    # Whole prompt replays from an empty cache: the slot
+                    # must start from a ZEROED cache_index, not whatever
+                    # the previous occupant left behind.
+                    self._cache = self.engine.evict_slot(self._cache, slot)
+            self._slots[slot] = _Slot(
+                request, response, list(request.prompt[prefill_len:])
+            )
+            self._rngs[slot] = _prng_key(request.params.seed)
+            admitted.append(request.id)
+            self._registry.counter("serving/requests_admitted_total").inc()
+
+    def _step(self, active: List[int], retired: List) -> None:
+        tokens = np.zeros((self.max_slots,), np.int32)
+        mask = np.zeros((self.max_slots,), bool)
+        for slot in active:
+            state = self._slots[slot]
+            if state.pending:
+                tokens[slot] = state.pending[0]
+                mask[slot] = len(state.pending) == 1
+            else:
+                tokens[slot] = state.last_token
+                mask[slot] = True
+        self._cache, emitted, rngs = self.engine.step(
+            self.params, self._cache, tokens, self._rngs, mask,
+            temperature=self.temperature, top_k=self.top_k, top_p=self.top_p,
+        )
+        # The tick's one host sync: every slot's token in one transfer.
+        emitted = np.asarray(emitted)
+        # np.array (copy): admissions write PRNGKey rows into this
+        # buffer, and np.asarray of a device array is read-only.
+        self._rngs = np.array(rngs)
+        for slot in active:
+            state = self._slots[slot]
+            sampled = bool(mask[slot])
+            if state.pending:
+                state.pending.popleft()
+            if not sampled:
+                continue
+            token = int(emitted[slot])
+            state.last_token = token
+            state.emitted += 1
+            first = state.response.first_token_at is None
+            state.response._push(token)
+            if first:
+                self._registry.histogram("serving/ttft_seconds").observe(
+                    state.response.ttft_s
+                )
+            self._registry.counter("serving/tokens_generated_total").inc()
+            eos = state.request.params.eos_token
+            if eos is not None and token == eos:
+                self._retire(slot, FINISH_EOS, retired)
+            elif state.emitted >= state.request.params.max_new_tokens:
+                self._retire(slot, FINISH_LENGTH, retired)
+
+    def _retire(self, slot: int, reason: str, retired: List) -> None:
+        state = self._slots[slot]
+        self._slots[slot] = None
+        self._free.append(slot)
+        state.response._finish(reason)
+        retired.append((state.request.id, reason))
+        self._registry.counter(
+            "serving/requests_completed_total", reason=reason
+        ).inc()
+        self._registry.histogram("serving/request_seconds").observe(
+            time.monotonic() - state.request.submitted_at
+        )
+
+    # -- loop ---------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="serving-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not self.tick():
+                self._work.wait(IDLE_POLL_S)
+                self._work.clear()
+
+    def close(self) -> None:
+        """Stop the loop; fail queued and in-flight requests as
+        `shutdown` so no client blocks forever on a dead grid."""
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        for _request, response in self.queue.drain():
+            response._finish(FINISH_SHUTDOWN)
+        for slot in range(self.max_slots):
+            state = self._slots[slot]
+            if state is not None:
+                self._slots[slot] = None
+                self._free.append(slot)
+                state.response._finish(FINISH_SHUTDOWN)
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict:
+        """Host-side snapshot for /stats and the task's flushed metrics."""
+        snap = {
+            "max_slots": self.max_slots,
+            "active_slots": len([s for s in self._slots if s is not None]),
+            "free_slots": len(self._free),
+            "queue_depth": self.queue.depth,
+            "queue_capacity": self.queue.capacity,
+            "ticks": self._ticks,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+        }
+        engine_stats = getattr(self.engine, "stats", None)
+        if isinstance(engine_stats, dict):
+            snap["decode_engine"] = dict(engine_stats)
+        return snap
+
+
+def _prng_key(seed: int) -> np.ndarray:
+    """generate_legacy's PRNGKey(seed), as host uint32[2] for the rng
+    grid row."""
+    import jax
+
+    return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)

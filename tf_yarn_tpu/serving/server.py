@@ -1,0 +1,297 @@
+"""Threaded HTTP JSON frontend + the `serving` task body.
+
+Stdlib only (`http.server`), because the TPU VM image carries no web
+framework and the protocol is deliberately tiny:
+
+* ``POST /v1/generate`` — body ``{"prompt": [ids], "max_new_tokens": N,
+  "seed": S, "eos_token": E, "priority": P, "timeout_s": T,
+  "stream": bool}``. Non-streamed: one JSON reply with the full token
+  list. ``"stream": true``: a chunked response of one JSON line per
+  token as the scheduler emits it, closed by a ``{"done": true, ...}``
+  summary line — time-to-first-token is the scheduler's, not the
+  drain's. A full admission queue answers 429 with a ``Retry-After``
+  header (backpressure, not buffering); an unservable request
+  (sampling-config mismatch, context overflow) answers 400.
+* ``GET /healthz`` — liveness for load balancers and the watchdog's
+  human twin.
+* ``GET /stats`` — the scheduler snapshot + decode-engine compile
+  stats as JSON.
+
+`run_serving` is the task program body (tasks/serving.py): restore the
+checkpoint exactly as batch inference does, build the shared
+DecodeEngine, start the scheduler loop + frontend, advertise the
+endpoint through the KV store for discovery, and serve until the
+deadline/SIGTERM-drain/duration says stop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.serving.request import QueueFull, SamplingParams
+from tf_yarn_tpu.serving.scheduler import SlotScheduler
+
+_logger = logging.getLogger(__name__)
+
+
+class ServingServer:
+    """The HTTP frontend over one SlotScheduler. Request handling is
+    per-connection threaded (ThreadingHTTPServer), so a slow streaming
+    client never blocks admissions."""
+
+    def __init__(self, scheduler: SlotScheduler, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = _make_handler(scheduler)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.scheduler = scheduler
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"{host}:{self.port}"
+
+    def start(self) -> str:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http", daemon=True
+        )
+        self._thread.start()
+        _logger.info("serving frontend listening on %s", self.endpoint)
+        return self.endpoint
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def _make_handler(scheduler: SlotScheduler):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # stdlib logs to stderr per hit
+            _logger.debug("http %s", fmt % args)
+
+        # -- helpers ---------------------------------------------------
+
+        def _json(self, status: int, payload: dict, headers=()) -> None:
+            body = (json.dumps(payload) + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _chunk(self, payload: dict) -> None:
+            data = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            self.wfile.flush()
+
+        # -- routes ----------------------------------------------------
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                snap = scheduler.stats()
+                self._json(200, {
+                    "status": "ok",
+                    "active_slots": snap["active_slots"],
+                    "queue_depth": snap["queue_depth"],
+                })
+            elif self.path == "/stats":
+                self._json(200, scheduler.stats())
+            else:
+                self._json(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = body["prompt"]
+                params = SamplingParams(
+                    max_new_tokens=int(body.get("max_new_tokens", 128)),
+                    temperature=float(
+                        body.get("temperature", scheduler.temperature)
+                    ),
+                    top_k=body.get("top_k", scheduler.top_k),
+                    top_p=body.get("top_p", scheduler.top_p),
+                    seed=int(body.get("seed", 0)),
+                    eos_token=body.get("eos_token"),
+                )
+            except (KeyError, TypeError, ValueError) as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            timeout_s = body.get("timeout_s")
+            try:
+                response = scheduler.submit(
+                    prompt, params,
+                    priority=int(body.get("priority", 0)),
+                    timeout_s=timeout_s,
+                )
+            except QueueFull as exc:
+                # Backpressure crosses the wire as a 429 + Retry-After:
+                # the client sheds or retries, the server never buffers
+                # past its bound.
+                self._json(
+                    429,
+                    {"error": str(exc), "retry_after_s": exc.retry_after_s},
+                    headers=(("Retry-After",
+                              str(max(1, int(exc.retry_after_s)))),),
+                )
+                return
+            except ValueError as exc:
+                self._json(400, {"error": str(exc)})
+                return
+
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for token in response.tokens():
+                        self._chunk({"token": token})
+                    self._chunk({
+                        "done": True,
+                        "finish_reason": response.finish_reason,
+                        "request_id": response.request.id,
+                        "n_tokens": len(response.result(timeout=0.0)),
+                    })
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    _logger.info(
+                        "client dropped streaming request %d",
+                        response.request.id,
+                    )
+                return
+
+            # Non-streamed: wait for the whole generation. The wait is
+            # bounded by the request's own deadline when it has one; a
+            # small margin covers the scheduler's retire latency.
+            wait = timeout_s + 5.0 if timeout_s else None
+            try:
+                tokens = response.result(timeout=wait)
+            except TimeoutError as exc:
+                self._json(504, {"error": str(exc)})
+                return
+            self._json(200, {
+                "tokens": tokens,
+                "finish_reason": response.finish_reason,
+                "request_id": response.request.id,
+                "ttft_s": response.ttft_s,
+            })
+
+    return Handler
+
+
+def _routable_host() -> str:
+    """This machine's address as other hosts see it (the UDP-connect
+    trick client.py uses for the coordinator; no packet is sent)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+            sock.connect(("8.8.8.8", 80))
+            return sock.getsockname()[0]
+    except OSError:
+        return socket.getfqdn()
+
+
+def advertised_endpoint(bind_host: str, port: int) -> str:
+    """The address peers should dial for a frontend bound on
+    `bind_host:port` — wildcard/loopback binds advertise a routable
+    interface instead."""
+    if bind_host in ("0.0.0.0", "", "::"):
+        return f"{_routable_host()}:{port}"
+    return f"{bind_host}:{port}"
+
+
+def run_serving(experiment, runtime=None) -> dict:
+    """Task body for a ServingExperiment: restore → engine → scheduler →
+    frontend → advertise → serve. Returns the final stats snapshot."""
+    from tf_yarn_tpu import event, fs as fs_lib, inference, preemption
+    from tf_yarn_tpu.models.decode_engine import get_engine
+
+    telemetry_task = "serving"
+    if runtime is not None:
+        telemetry_task = getattr(
+            runtime, "task",
+            f"{runtime.task_key.type}:{runtime.task_key.id}",
+        )
+    telemetry.enable_env_jsonl(telemetry_task)
+    fs_lib.check_model_dir_placement(experiment.model_dir)
+    with telemetry.span("serving/restore_params"):
+        variables, step = inference._restore_params(
+            experiment.model_dir, experiment.step
+        )
+    engine = get_engine(experiment.model)
+    scheduler = SlotScheduler(
+        engine,
+        variables,
+        max_slots=experiment.max_slots,
+        temperature=experiment.temperature,
+        top_k=experiment.top_k,
+        top_p=experiment.top_p,
+        queue_capacity=experiment.queue_capacity,
+        retry_after_s=experiment.retry_after_s,
+    )
+    server = ServingServer(scheduler, experiment.host, experiment.port)
+    scheduler.start()
+    endpoint = server.start()
+    advertised = advertised_endpoint(experiment.host, server.port)
+    if runtime is not None:
+        # Discovery: clients (and the driver's one-shot logger) read the
+        # endpoint from the KV store instead of guessing ports.
+        event.serving_endpoint_event(runtime.kv, runtime.task, advertised)
+    _logger.info(
+        "serving ckpt-%d on %s (advertised %s): max_slots=%d, queue=%d",
+        step, endpoint, advertised, experiment.max_slots,
+        experiment.queue_capacity,
+    )
+
+    deadline = (
+        time.monotonic() + experiment.serve_seconds
+        if experiment.serve_seconds is not None else None
+    )
+    try:
+        while True:
+            if preemption.requested():
+                _logger.info("serving task draining on preemption notice")
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                _logger.info(
+                    "serve_seconds=%.1f elapsed; shutting down",
+                    experiment.serve_seconds,
+                )
+                break
+            time.sleep(0.2)
+    finally:
+        server.stop()
+        scheduler.close()
+        stats = {"endpoint": advertised, "ckpt_step": step,
+                 **scheduler.stats()}
+        _logger.info("serving done: %s", stats)
+        telemetry.flush_metrics(
+            telemetry.get_registry(),
+            kv=getattr(runtime, "kv", None),
+            task=telemetry_task if runtime is not None else None,
+        )
+        telemetry.export_trace(telemetry_task)
+    return stats

@@ -1,0 +1,67 @@
+"""Task program for the ``serving`` task type.
+
+The online-inference sibling of tasks/worker.py: bootstrap, pull the
+ServingExperiment from the KV store, and run the continuous-batching
+server (`tf_yarn_tpu.serving.server.run_serving`) under the same
+lifecycle events, heartbeats, and failure classification the training
+tasks get — so a crashed serving task is classified through its stop
+event and relaunched by the driver's RetryPolicy, and the heartbeat
+watchdog turns a wedged-but-alive server into a LOST_TASK within one
+poll.
+
+SIGTERM (the TPU-VM preemption notice) sets the drain flag
+`run_serving` polls: the frontend stops accepting, in-flight responses
+finish as ``shutdown``, and the task exits cleanly instead of dying
+mid-chunk.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event, telemetry
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def _run(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    from tf_yarn_tpu import experiment as experiment_mod
+
+    if not isinstance(experiment, experiment_mod.ServingExperiment):
+        raise TypeError(
+            f"serving tasks expect a ServingExperiment, got "
+            f"{type(experiment)!r}"
+        )
+    experiment_mod.run_experiment(runtime, experiment)
+
+
+def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    preemption.install()
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        # MonitoredThread so the captured exception carries the serving
+        # stack into the stop event (classification reads it there).
+        thread = MonitoredThread(
+            target=_run,
+            args=(runtime, experiment),
+            name=f"serve-{runtime.task}",
+        )
+        with telemetry.Heartbeat(
+            runtime.kv, runtime.task,
+            every=telemetry.heartbeat.every_from_env(),
+            registry=telemetry.get_registry(),
+        ):
+            thread.start()
+            thread.join()
+        if thread.exception is not None:
+            raise thread.exception
+
+
+if __name__ == "__main__":
+    main()

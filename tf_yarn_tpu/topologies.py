@@ -29,7 +29,7 @@ MAX_HOST_MEMORY_GIB = 448
 MAX_HOST_VCORES = 224
 MAX_CHIPS_PER_HOST = 8
 
-ALL_TASK_TYPES = {"chief", "worker", "evaluator", "tensorboard"}
+ALL_TASK_TYPES = {"chief", "worker", "evaluator", "tensorboard", "serving"}
 
 # Known slice shapes: name -> (total chips, hosts). Used by
 # `tpu_slice_topology` to expand a slice type into a host/chip layout.
@@ -152,9 +152,12 @@ def _check_general_topology(task_specs: TaskSpecs) -> None:
     if "chief" in task_specs and task_specs["chief"].instances > 1:
         raise ValueError("at most one chief is allowed")
     if not any(
-        t in task_specs and task_specs[t].instances > 0 for t in ("chief", "worker")
+        t in task_specs and task_specs[t].instances > 0
+        for t in ("chief", "worker", "serving")
     ):
-        raise ValueError("need at least one chief or worker instance")
+        raise ValueError(
+            "need at least one chief, worker, or serving instance"
+        )
     for task_type in ("evaluator", "tensorboard"):
         if task_type in task_specs and task_specs[task_type].instances > 1:
             raise ValueError(f"at most one {task_type} is allowed")
@@ -225,6 +228,33 @@ def allreduce_topology(
         specs["evaluator"] = TaskSpec(
             memory_gib=memory_gib, vcores=vcores, instances=1, label=NodeLabel.CPU
         )
+    check_topology(specs)
+    return specs
+
+
+def serving_topology(
+    instances: int = 1,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    chips_per_host: int = 1,
+) -> TaskSpecs:
+    """`instances` independent online-serving replicas, each driving
+    `chips_per_host` local chips (tf_yarn_tpu.serving; docs/Serving.md).
+    Replicas share nothing — each restores the checkpoint and serves its
+    own slot grid; each advertises its own endpoint through the KV
+    store, so a load balancer (or the driver's logged endpoints) fans
+    traffic out across them."""
+    if instances < 1:
+        raise ValueError(f"instances must be >= 1, got {instances}")
+    specs: TaskSpecs = {
+        "serving": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=instances,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU if chips_per_host else NodeLabel.CPU,
+        )
+    }
     check_topology(specs)
     return specs
 

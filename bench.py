@@ -364,6 +364,19 @@ def _stale_tpu_fields() -> dict:
             fields[f"last_tpu_serve_{policy}_ttft_p95_ms"] = row.get(
                 "ttft_p95_ms"
             )
+    for layout in ("dense", "paged", "paged_int8"):
+        row = (serve.get("layouts") or {}).get(layout) or {}
+        if "tokens_per_sec" in row:
+            fields[f"last_tpu_serve_{layout}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            fields[f"last_tpu_serve_{layout}_slots_per_gb_hbm"] = row.get(
+                "slots_per_gb_hbm"
+            )
+    for key in ("paged_vs_dense_slots_per_gb",
+                "paged_int8_vs_dense_slots_per_gb"):
+        if key in serve:
+            fields[f"last_tpu_serve_{key}"] = serve[key]
     return fields
 
 
@@ -499,6 +512,10 @@ def bench_flagship_train():
             + "; ".join(str(r.get("error", ""))[:80] for r in table) + ")",
         }
         result.update(_stale_tpu_fields())
+        if not on_tpu:
+            # The serve layout A/B does not ride the train mesh — it can
+            # still land its memory-accounting evidence.
+            _record_cpu_serve_ab(result)
         return result, None
     best = max(ok_rows, key=lambda r: r["samples_per_sec_per_chip"])
 
@@ -535,6 +552,7 @@ def bench_flagship_train():
                  f"({stale.get('last_tpu_device')}, commit "
                  f"{stale.get('last_tpu_commit')}, {stale.get('last_tpu_date')})")
             result.update(stale)
+        _record_cpu_serve_ab(result)
         return result, None
 
     # --- TPU: persist the A/B table incrementally (flagship first, so a
@@ -611,6 +629,21 @@ def bench_flagship_train():
                 result[f"serve_{policy}_ttft_p95_ms"] = (
                     serve[policy]["ttft_p95_ms"]
                 )
+            # KV-layout A/B: slots-per-GB-HBM is the concurrency-per-
+            # chip lever paged/int8 exist for (same trace, same slots).
+            for layout in ("dense", "paged", "paged_int8"):
+                row = (serve.get("layouts") or {}).get(layout) or {}
+                if "tokens_per_sec" in row:
+                    result[f"serve_{layout}_tokens_per_sec"] = row[
+                        "tokens_per_sec"
+                    ]
+                    result[f"serve_{layout}_slots_per_gb_hbm"] = row.get(
+                        "slots_per_gb_hbm"
+                    )
+            for key in ("paged_vs_dense_slots_per_gb",
+                        "paged_int8_vs_dense_slots_per_gb"):
+                if key in serve:
+                    result[f"serve_{key}"] = serve[key]
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
@@ -634,6 +667,50 @@ def bench_flagship_train():
     # line prints (main) — a driver timeout mid-matrix must never cost
     # the round its headline record.
     return result, (suite, ab)
+
+
+def _record_cpu_serve_ab(result: dict) -> None:
+    """The serving KV-layout A/B (dense vs paged vs paged+int8
+    slots-per-GB-HBM under one Poisson trace) is tiny-model-cheap, so it
+    runs even on the CPU rig: the memory-accounting ratios are layout
+    properties, not device speed, and a wedged relay must not leave the
+    paged-KV evidence unrecorded. Written to BENCH_AB.json as an
+    explicitly CPU-labeled `serve_cpu` section (the TPU `serve` section
+    keeps its own provenance), plus `serve_cpu_*` fields on the headline
+    line."""
+    try:
+        suite = _load_bench_suite()
+        serve = suite.bench_serve(tpu=False)
+    except Exception as exc:  # the bench headline must still print
+        _log(f"cpu serve bench FAILED: {type(exc).__name__}: {exc}")
+        return
+    for key in ("paged_vs_dense_slots_per_gb",
+                "paged_int8_vs_dense_slots_per_gb"):
+        if key in serve:
+            result[f"serve_cpu_{key}"] = serve[key]
+    layouts = serve.get("layouts") or {}
+    for layout in ("dense", "paged", "paged_int8"):
+        row = layouts.get(layout) or {}
+        if "slots_per_gb_hbm" in row:
+            result[f"serve_cpu_{layout}_slots_per_gb_hbm"] = row[
+                "slots_per_gb_hbm"
+            ]
+            result[f"serve_cpu_{layout}_tokens_per_sec"] = row.get(
+                "tokens_per_sec"
+            )
+    try:
+        with open(_AB_PATH) as fh:
+            table = json.load(fh)
+    except (OSError, ValueError):
+        table = {}
+    table["serve_cpu"] = {
+        **serve,
+        "device": "cpu",
+        "git_commit": _git_head(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    _write_ab(table)
+    _log(f"cpu serve layout A/B: {serve.get('layouts')}")
 
 
 def _run_family_blitz(suite, ab) -> None:

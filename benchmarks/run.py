@@ -542,12 +542,17 @@ def bench_decode(tpu: bool):
 
 
 def bench_serve(tpu: bool):
-    """Online-serving throughput/TTFT: continuous batching (slot
-    scheduler, freed slots re-admitted next tick) vs static batching
-    (same slot grid, but admissions wait for the whole batch to drain)
-    under ONE seeded Poisson arrival trace. Same engine, same compiled
-    step program — the delta is purely the scheduling policy, which is
-    the number this bench exists to pin."""
+    """Online-serving A/B matrix under ONE seeded Poisson arrival trace:
+
+    * **policy** — continuous batching (freed slots re-admitted next
+      tick) vs static batching (admissions wait for the whole batch to
+      drain), same dense grid: the scheduling-policy delta.
+    * **KV layout** — dense per-slot caches vs the paged block pool
+      (sized BELOW dense-equivalent) vs paged + int8 KV, all continuous:
+      the memory-engineering delta. Each layout row reports resident KV
+      HBM and slots-per-GB — the concurrency-per-chip lever paged/int8
+      exist to multiply — alongside throughput and tail TTFT to show the
+      capacity is not bought with latency."""
     import time
 
     import flax.linen as nn
@@ -562,17 +567,21 @@ def bench_serve(tpu: bool):
 
     select_devices()
     if tpu:
-        config = TransformerConfig(
+        base_cfg = dict(
             vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
             scan_layers=False,
         )
+        config = TransformerConfig(**base_cfg)
         n_requests, max_slots, mean_gap_s = 32, 8, 0.02
         prompt_lens, max_new_range = (64, 128, 256), (32, 256)
+        block_size = 16
     else:
-        config = TransformerConfig.tiny(scan_layers=False, max_seq_len=64)
+        base_cfg = dict(scan_layers=False, max_seq_len=64)
+        config = TransformerConfig.tiny(**base_cfg)
         n_requests, max_slots, mean_gap_s = 12, 4, 0.005
         prompt_lens, max_new_range = (5, 9, 14), (2, 16)
+        block_size = 8
     model = Transformer(config)
     rng = np.random.RandomState(0)
     params = nn.meta.unbox(
@@ -582,7 +591,7 @@ def bench_serve(tpu: bool):
         )
     )
 
-    # One seeded Poisson trace shared by both policies.
+    # One seeded Poisson trace shared by every policy and layout.
     gaps = rng.exponential(mean_gap_s, n_requests)
     arrivals = np.cumsum(gaps)
     requests = [
@@ -595,12 +604,19 @@ def bench_serve(tpu: bool):
         for i in range(n_requests)
     ]
     total_tokens = sum(m for _, _, m in requests)
+    # Paged pool sized to the trace's worst-case concurrent residency
+    # (every slot holding its longest possible request), NOT to
+    # max_slots full contexts — the HBM the dense layout wastes on
+    # padding is exactly the gap between these two numbers.
+    worst_tokens = max(prompt_lens) + max_new_range[1] - 1
+    paged_blocks = max_slots * (-(-worst_tokens // block_size)) + 1
 
-    def run_policy(continuous: bool):
-        engine = DecodeEngine(model)
+    def run_policy(continuous: bool, run_model=None,
+                   scheduler_kwargs=None):
+        engine = DecodeEngine(run_model if run_model is not None else model)
         scheduler = SlotScheduler(
             engine, params, max_slots=max_slots,
-            queue_capacity=n_requests,
+            queue_capacity=n_requests, **(scheduler_kwargs or {}),
         )
         scheduler.start()
         try:
@@ -647,6 +663,8 @@ def bench_serve(tpu: bool):
                 (response.first_token_at - t0) - offset
                 for response, offset in responses
             )
+            stats = scheduler.stats()
+            kv_bytes = stats["kv_cache_hbm_bytes"]
             return {
                 "tokens_per_sec": round(total_tokens / wall, 2),
                 "wall_s": round(wall, 3),
@@ -654,7 +672,14 @@ def bench_serve(tpu: bool):
                     1000 * sum(ttfts) / len(ttfts), 2),
                 "ttft_p95_ms": round(
                     1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 2),
-                "step_compiles": engine.stats["step_compiles"],
+                "step_compiles": engine.stats["step_compiles"]
+                + engine.stats["paged_step_compiles"],
+                "kv_hbm_bytes": kv_bytes,
+                "slots_per_gb_hbm": round(
+                    max_slots / (kv_bytes / 2**30), 2) if kv_bytes else None,
+                "prefix_cache_hit_rate": (
+                    stats.get("prefix_cache", {}).get("hit_rate")
+                ),
             }
         finally:
             scheduler.close()
@@ -665,13 +690,53 @@ def bench_serve(tpu: bool):
         round(continuous["tokens_per_sec"] / static["tokens_per_sec"], 3)
         if static["tokens_per_sec"] else None
     )
+
+    # KV-layout A/B (all continuous): dense is the run above; paged
+    # shrinks the pool below dense-equivalent; paged_int8 halves the
+    # bytes per cached token on top.
+    paged_kwargs = dict(
+        kv_layout="paged", block_size=block_size, num_blocks=paged_blocks,
+    )
+    layouts = {"dense": continuous}
+    try:
+        layouts["paged"] = run_policy(
+            continuous=True, scheduler_kwargs=paged_kwargs
+        )
+    except Exception as exc:  # noqa: BLE001 - record, keep benching
+        layouts["paged"] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    try:
+        int8_model = Transformer(
+            TransformerConfig(**base_cfg, kv_cache_dtype="int8")
+            if tpu else TransformerConfig.tiny(
+                **base_cfg, kv_cache_dtype="int8")
+        )
+        layouts["paged_int8"] = run_policy(
+            continuous=True, run_model=int8_model,
+            scheduler_kwargs=paged_kwargs,
+        )
+    except Exception as exc:  # noqa: BLE001
+        layouts["paged_int8"] = {
+            "error": f"{type(exc).__name__}: {exc}"[:160]
+        }
+    ratios = {}
+    dense_spg = continuous.get("slots_per_gb_hbm")
+    for name in ("paged", "paged_int8"):
+        spg = layouts[name].get("slots_per_gb_hbm")
+        if spg and dense_spg:
+            ratios[f"{name}_vs_dense_slots_per_gb"] = round(
+                spg / dense_spg, 2
+            )
     return {
         "requests": n_requests,
         "max_slots": max_slots,
         "total_tokens": total_tokens,
+        "block_size": block_size,
+        "paged_num_blocks": paged_blocks,
         "continuous": continuous,
         "static": static,
         "continuous_vs_static_speedup": speedup,
+        "layouts": layouts,
+        **ratios,
     }
 
 

@@ -242,6 +242,18 @@ def test_jaxpr_engine_default_entries_clean_on_this_build():
     # where a smuggled host callback would hurt most.
     assert "models.decode_engine.step" in counts
     assert counts["models.decode_engine.step"]["dot_general"] > 0
+    # the PAGED serving programs: the step must contain the block-table
+    # gather AND the scatter-append (the whole point of the layout),
+    # with the same host-callback-free bar — findings == [] above
+    # already asserts both paged entries trace clean.
+    assert "models.decode_engine.paged_step" in counts
+    paged = counts["models.decode_engine.paged_step"]
+    assert paged["dot_general"] > 0
+    assert paged.get("gather", 0) > 0
+    assert paged.get("dynamic_update_slice", 0) > 0
+    assert "models.decode_engine.paged_prefill" in counts
+    assert counts["models.decode_engine.paged_prefill"][
+        "dynamic_update_slice"] > 0
 
 
 def test_finding_format_and_json_roundtrip():

@@ -18,10 +18,13 @@ from tf_yarn_tpu.models import transformer
 from tf_yarn_tpu.models.decode_engine import (
     DecodeEngine,
     build_decode_fn,
+    build_paged_step_fn,
     build_prefill_fn,
     build_step_fn,
+    cache_nbytes,
     clear_engines,
     get_engine,
+    paged_pool_avals,
 )
 from tf_yarn_tpu.models.generate import generate, generate_legacy
 
@@ -370,6 +373,243 @@ def test_insert_and_evict_slot_splice():
     grid = engine.evict_slot(grid, 1)
     for _path, leaf in jax.tree_util.tree_leaves_with_path(grid):
         assert not np.asarray(leaf).any()
+
+
+def _drive_paged_slots(model, engine, params, prompts, seeds, max_new,
+                       sampling, block_size):
+    """Drive make_paged_pool/pack_prefill/paged_step by hand (the
+    scheduler's device contract) and return each slot's emitted stream.
+    Physical blocks are handed out in an interleaved order on purpose —
+    correctness must come from the block TABLE, not from contiguity."""
+    slots = len(prompts)
+    max_blocks = engine.max_blocks_per_slot(block_size)
+    num_blocks = 1 + slots * max_blocks
+    pool = engine.make_paged_pool(params, num_blocks, block_size)
+    # Interleaved physical ids: slot 0 gets 1, 1+slots, 1+2*slots, ...
+    tables = np.zeros((slots, max_blocks), np.int32)
+    for s in range(slots):
+        tables[s] = 1 + s + slots * np.arange(max_blocks)
+    lengths = np.zeros((slots,), np.int32)
+    rngs = np.zeros((slots, 2), np.uint32)
+    pending, last, emitted_all = [], np.zeros((slots,), np.int32), []
+    for slot, (prompt, seed) in enumerate(zip(prompts, seeds)):
+        prefill_len = engine.slot_prefill_len(len(prompt))
+        if prefill_len > 0:
+            row, _ = engine.prefill(params, prompt[None, :prefill_len])
+            n_pack = -(-prefill_len // block_size)
+            pool = engine.pack_prefill(
+                pool, tables[slot, :n_pack], row, prefill_len, block_size
+            )
+        lengths[slot] = prefill_len
+        pending.append(list(prompt[prefill_len:]))
+        rngs[slot] = np.asarray(jax.random.PRNGKey(seed))
+        emitted_all.append([])
+
+    for _ in range(max_new + max(len(p) for p in pending)):
+        tokens = np.zeros((slots,), np.int32)
+        mask = np.zeros((slots,), bool)
+        step_lengths = np.array(lengths)
+        for slot in range(slots):
+            if len(emitted_all[slot]) >= max_new:
+                step_lengths[slot] = 0  # finished slot rides along inactive
+                continue
+            if pending[slot]:
+                tokens[slot] = pending[slot][0]
+                mask[slot] = len(pending[slot]) == 1
+            else:
+                tokens[slot] = last[slot]
+                mask[slot] = True
+        if not mask.any():
+            break
+        finished = [len(e) >= max_new for e in emitted_all]
+        step_tables = np.array(tables)
+        step_tables[finished] = 0  # inactive rows write the trash block
+        pool, emitted, rngs_out = engine.paged_step(
+            params, pool, step_tables, step_lengths, tokens, rngs, mask,
+            block_size=block_size, **sampling,
+        )
+        emitted = np.asarray(emitted)
+        rngs = np.array(rngs_out)
+        for slot in range(slots):
+            if finished[slot]:
+                continue
+            lengths[slot] += 1
+            if pending[slot]:
+                sampled = len(pending[slot]) == 1
+                pending[slot].pop(0)
+                if not sampled:
+                    continue
+            emitted_all[slot].append(int(emitted[slot]))
+            last[slot] = emitted[slot]
+    return emitted_all
+
+
+def test_paged_step_grid_matches_legacy_per_request():
+    """The paged serving contract: slots at different prompt lengths and
+    seeds, block tables pointing at interleaved physical blocks, prompts
+    split across prefill-pack + replay — every per-request stream is
+    BIT-IDENTICAL to generate_legacy, including sampled RNG chains."""
+    model, params = _model_and_params()
+    engine = _engine(model, batch_buckets=(1, 2, 4),
+                     prompt_buckets=(4, 8, 16))
+    rng_np = np.random.RandomState(6)
+    prompts = [
+        jnp.asarray(rng_np.randint(0, 256, (5,)), jnp.int32),  # prefill 4
+        jnp.asarray(rng_np.randint(0, 256, (9,)), jnp.int32),  # prefill 8
+        jnp.asarray(rng_np.randint(0, 256, (3,)), jnp.int32),  # replay all
+    ]
+    seeds = [0, 7, 3]
+    max_new = 6
+    sampling = dict(temperature=1.0, top_k=8, top_p=0.9)
+    # block_size 8 with prefill 4: pack_prefill covers the partial-block
+    # path too.
+    emitted_all = _drive_paged_slots(
+        model, engine, params, prompts, seeds, max_new, sampling,
+        block_size=8,
+    )
+    for slot, (prompt, seed) in enumerate(zip(prompts, seeds)):
+        ref = generate_legacy(
+            model, params, prompt[None], max_new, seed=seed, **sampling
+        )
+        assert emitted_all[slot] == np.asarray(
+            ref
+        )[0, len(prompt):].tolist(), f"slot {slot}"
+    # One grid configuration = ONE compiled paged step program, reused
+    # every tick.
+    assert engine.stats["paged_step_compiles"] == 1
+    assert engine.stats["paged_step_cache_hits"] >= max_new - 1
+    # Two prefill buckets -> two pack programs (4-token partial block,
+    # 8-token full block), each compiled once.
+    assert engine.stats["pack_compiles"] == 2
+
+
+def test_paged_step_int8_matches_int8_legacy():
+    """The pool stores whatever leaves the model's cache has — int8
+    values and scales page identically, and the stream stays bit-equal
+    to the int8 legacy path (the paging machinery adds no error of its
+    own; int8-vs-fp accuracy is test_int8_prefill_logits_close_to_fp)."""
+    model, params = _model_and_params(kv_cache_dtype="int8")
+    engine = _engine(model, batch_buckets=(1, 2, 4),
+                     prompt_buckets=(4, 8, 16))
+    rng_np = np.random.RandomState(7)
+    prompts = [jnp.asarray(rng_np.randint(0, 256, (9,)), jnp.int32),
+               jnp.asarray(rng_np.randint(0, 256, (5,)), jnp.int32)]
+    emitted_all = _drive_paged_slots(
+        model, engine, params, prompts, [0, 1], 5,
+        dict(temperature=0.0), block_size=8,
+    )
+    for slot, prompt in enumerate(prompts):
+        ref = generate_legacy(model, params, prompt[None], 5,
+                              temperature=0.0)
+        assert emitted_all[slot] == np.asarray(
+            ref
+        )[0, len(prompt):].tolist(), f"slot {slot}"
+
+
+def test_int8_prefill_logits_close_to_fp():
+    """Parity tolerance for the int8 KV path against fp: same prompt,
+    same weights, prefill logits within quantization noise."""
+    model_fp, params = _model_and_params()
+    model_int8, _ = _model_and_params(kv_cache_dtype="int8")
+    prompt = jnp.asarray(
+        np.random.RandomState(8).randint(0, 256, (1, 12)), jnp.int32
+    )
+    engine_fp = _engine(model_fp)
+    engine_int8 = _engine(model_int8)
+    _row, logits_fp = engine_fp.prefill(params, prompt)
+    _row, logits_int8 = engine_int8.prefill(params, prompt)
+    diff = np.abs(np.asarray(logits_fp) - np.asarray(logits_int8)).max()
+    scale = np.abs(np.asarray(logits_fp)).max()
+    assert diff <= 0.05 * scale + 1e-3, (
+        f"int8 prefill logits diverge from fp: max diff {diff} vs "
+        f"logit scale {scale}"
+    )
+
+
+def test_paged_pool_layout_and_hbm_accounting():
+    """Pool leaves replace the seq axis with (num_blocks, block_size);
+    index leaves are elided; a pool sized below dense-equivalent is
+    proportionally smaller in bytes — the layout's entire point."""
+    model, params = _model_and_params()
+    engine = _engine(model)
+    max_seq = model.config.max_seq_len  # 64
+    slots, bs = 4, 8
+    dense = engine.make_slot_cache(params, slots)
+    dense_bytes = cache_nbytes(dense)
+    full = engine.make_paged_pool(params, slots * (max_seq // bs) + 1, bs)
+    half = engine.make_paged_pool(params, slots * (max_seq // bs) // 2, bs)
+    leaves = [l for l in jax.tree_util.tree_leaves(full)]
+    assert leaves, "pool has no KV leaves"
+    for leaf in leaves:
+        assert bs in leaf.shape
+    # cache_index leaves are gone from the pool (positions travel as the
+    # step's traced lengths instead).
+    n_dense_leaves = len(jax.tree_util.tree_leaves(dense))
+    assert len(leaves) < n_dense_leaves
+    half_bytes = cache_nbytes(half)
+    full_bytes = cache_nbytes(full)
+    assert half_bytes < full_bytes
+    # Same token capacity costs the same KV bytes (+1 trash block);
+    # fewer blocks = proportionally less resident HBM than dense.
+    assert half_bytes < dense_bytes
+    # aval helper agrees with the concrete pool
+    avals = paged_pool_avals(
+        jax.eval_shape(
+            build_prefill_fn(model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0],
+        slots * (max_seq // bs) + 1, bs, max_seq,
+    )
+    concrete = jax.tree_util.tree_leaves(full)
+    abstract = [a for a in jax.tree_util.tree_leaves(avals)]
+    assert [l.shape for l in concrete] == [a.shape for a in abstract]
+
+
+def test_paged_step_traces_with_zero_host_syncs():
+    """Jaxpr twin for the paged serving step: gather, model step, and
+    scatter-append in ONE program with no host-callback or transfer
+    primitive — the zero-host-syncs-per-tick acceptance bar."""
+    from tf_yarn_tpu.analysis.jaxpr_engine import (
+        _HOST_CALLBACK_PRIMITIVES,
+        _walk_jaxpr,
+    )
+
+    model, params = _model_and_params()
+    row = jax.eval_shape(
+        build_prefill_fn(model), params,
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),
+    )[0]
+    bs = 8
+    pool = paged_pool_avals(row, 9, bs, model.config.max_seq_len)
+    slots, mb = 2, model.config.max_seq_len // bs
+    fn = build_paged_step_fn(model, bs, temperature=1.0, top_k=4, top_p=0.9)
+    closed = jax.make_jaxpr(fn)(
+        params, pool,
+        jax.ShapeDtypeStruct((slots, mb), jnp.int32),
+        jax.ShapeDtypeStruct((slots,), jnp.int32),
+        jax.ShapeDtypeStruct((slots,), jnp.int32),
+        jax.ShapeDtypeStruct((slots, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((slots,), jnp.bool_),
+    )
+    prims = {eqn.primitive.name for eqn in _walk_jaxpr(closed.jaxpr)}
+    assert not prims & _HOST_CALLBACK_PRIMITIVES, sorted(
+        prims & _HOST_CALLBACK_PRIMITIVES
+    )
+    # The table indirection is real: the program gathers and scatters.
+    assert "gather" in prims
+    assert "dynamic_update_slice" in prims
+
+
+def test_paged_pool_validates():
+    model, params = _model_and_params()
+    engine = _engine(model)
+    with pytest.raises(ValueError, match="divide"):
+        engine.make_paged_pool(params, 9, 7)  # 64 % 7 != 0
+    with pytest.raises(ValueError, match="num_blocks"):
+        engine.make_paged_pool(params, 1, 8)
+    with pytest.raises(ValueError, match="divide"):
+        engine.max_blocks_per_slot(7)
+    assert engine.max_blocks_per_slot(8) == 8
 
 
 def test_engine_validates_like_generate():

@@ -552,3 +552,156 @@ def test_int8_decode_attention_gqa_group_mapping():
     # Heads 0-1 (group of kv head 0) average v=1; heads 2-3 see v=-3.
     np.testing.assert_allclose(out[0, :2], 1.0, atol=2e-2)
     np.testing.assert_allclose(out[0, 2:], -3.0, atol=6e-2)
+
+
+def test_quantize_int8_grouped_roundtrip_and_shapes():
+    # Per-block KV scales: one scale per group of rows (the paged pool's
+    # per-(block, head) layout); the shared scale is the group's loudest
+    # row, so the error bound is half that coarser step.
+    from tf_yarn_tpu.ops.quantize import (
+        dequantize_int8_grouped,
+        quantize_int8_grouped,
+    )
+
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(4, 64, 16).astype(np.float32) * 2.0)
+    values, scales = quantize_int8_grouped(x, group_rows=8)
+    assert values.shape == x.shape and values.dtype == jnp.int8
+    assert scales.shape == (4, 8, 1)  # 64 rows / 8 per group
+    recovered = dequantize_int8_grouped(values, scales, group_rows=8)
+    max_err = np.abs(np.asarray(recovered) - np.asarray(x)).max()
+    step = float(np.asarray(scales).max())
+    assert max_err <= step * 0.51 + 1e-6
+    with pytest.raises(ValueError, match="group_rows"):
+        quantize_int8_grouped(x, group_rows=0)
+    with pytest.raises(ValueError, match="divide"):
+        quantize_int8_grouped(x, group_rows=7)
+
+
+def _build_paged_int8_pool(rng, slots, max_blocks, num_blocks, block_size,
+                           n_kv, head_dim, per_block_scales=False):
+    """Random dense caches scattered into a shuffled pool; returns the
+    pool pieces + tables + the dense per-slot quantized reference."""
+    from tf_yarn_tpu.ops.quantize import quantize_int8, quantize_int8_grouped
+
+    dense_k = rng.randn(slots, max_blocks * block_size, n_kv,
+                        head_dim).astype(np.float32)
+    dense_v = rng.randn(slots, max_blocks * block_size, n_kv,
+                        head_dim).astype(np.float32)
+    tables = rng.permutation(
+        np.arange(1, num_blocks)
+    )[:slots * max_blocks].reshape(slots, max_blocks).astype(np.int32)
+    sb = 1 if per_block_scales else block_size
+    kp = np.zeros((num_blocks, block_size, n_kv, head_dim), np.int8)
+    vp = np.zeros_like(kp)
+    ksp = np.zeros((num_blocks, sb, n_kv, 1), np.float32)
+    vsp = np.zeros_like(ksp)
+    dense_quant = []
+    for s in range(slots):
+        if per_block_scales:
+            # one scale per (block, head): group the block's rows.
+            kq = np.zeros_like(dense_k[s], dtype=np.int8)
+            ks = np.zeros((max_blocks * block_size, n_kv, 1), np.float32)
+            vq = np.zeros_like(kq)
+            vs = np.zeros_like(ks)
+            for j in range(max_blocks):
+                rows = slice(j * block_size, (j + 1) * block_size)
+                for h in range(n_kv):
+                    qv, qs = quantize_int8_grouped(
+                        jnp.asarray(dense_k[s, rows, h])[None], block_size
+                    )
+                    kq[rows, h] = np.asarray(qv)[0]
+                    ks[rows, h, 0] = float(np.asarray(qs)[0, 0, 0])
+                    ksp[tables[s, j], 0, h, 0] = float(
+                        np.asarray(qs)[0, 0, 0])
+                    qv, qs = quantize_int8_grouped(
+                        jnp.asarray(dense_v[s, rows, h])[None], block_size
+                    )
+                    vq[rows, h] = np.asarray(qv)[0]
+                    vs[rows, h, 0] = float(np.asarray(qs)[0, 0, 0])
+                    vsp[tables[s, j], 0, h, 0] = float(
+                        np.asarray(qs)[0, 0, 0])
+                kp[tables[s, j]] = kq[rows]
+                vp[tables[s, j]] = vq[rows]
+            dense_quant.append((kq, ks, vq, vs))
+        else:
+            kq, ks = quantize_int8(jnp.asarray(dense_k[s]))
+            vq, vs = quantize_int8(jnp.asarray(dense_v[s]))
+            for j in range(max_blocks):
+                rows = slice(j * block_size, (j + 1) * block_size)
+                kp[tables[s, j]] = np.asarray(kq)[rows]
+                vp[tables[s, j]] = np.asarray(vq)[rows]
+                ksp[tables[s, j]] = np.asarray(ks)[rows]
+                vsp[tables[s, j]] = np.asarray(vs)[rows]
+            dense_quant.append((np.asarray(kq), np.asarray(ks),
+                                np.asarray(vq), np.asarray(vs)))
+    return kp, ksp, vp, vsp, tables, dense_quant
+
+
+def test_paged_int8_decode_attention_matches_dense_kernel():
+    """The paged kernel walks each slot's block table (SMEM scalar
+    prefetch) over a shuffled physical pool and must equal the dense
+    int8 kernel on the gathered cache — table indirection only, no new
+    math."""
+    from tf_yarn_tpu.ops.decode_attention import (
+        int8_decode_attention,
+        paged_int8_decode_attention,
+    )
+
+    slots, H, Hkv, D = 3, 8, 4, 64
+    block_size, max_blocks, num_blocks = 32, 4, 14
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(slots, H, D), jnp.float32)
+    lengths = np.array([1, 70, 128], np.int32)
+    kp, ksp, vp, vsp, tables, dense = _build_paged_int8_pool(
+        rng, slots, max_blocks, num_blocks, block_size, Hkv, D
+    )
+    out = paged_int8_decode_attention(
+        q, jnp.asarray(kp), jnp.asarray(ksp), jnp.asarray(vp),
+        jnp.asarray(vsp), jnp.asarray(tables), jnp.asarray(lengths),
+    )
+    for s in range(slots):
+        kq, ks, vq, vs = dense[s]
+        ref = int8_decode_attention(
+            q[s:s + 1], jnp.asarray(kq)[None], jnp.asarray(ks)[None],
+            jnp.asarray(vq)[None], jnp.asarray(vs)[None],
+            int(lengths[s]), block_k=block_size,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[s], np.asarray(ref)[0], atol=1e-5,
+            err_msg=f"slot {s}",
+        )
+
+
+def test_paged_int8_decode_attention_per_block_scales():
+    """sb=1 scale pools (quantize_int8_grouped per block+head) broadcast
+    inside the kernel; reference = dequantized dense attention."""
+    from tf_yarn_tpu.ops.attention import xla_attention
+    from tf_yarn_tpu.ops.decode_attention import paged_int8_decode_attention
+
+    slots, H, Hkv, D = 2, 4, 2, 64
+    block_size, max_blocks, num_blocks = 32, 2, 6
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(slots, H, D), jnp.float32)
+    lengths = np.array([40, 64], np.int32)
+    kp, ksp, vp, vsp, tables, dense = _build_paged_int8_pool(
+        rng, slots, max_blocks, num_blocks, block_size, Hkv, D,
+        per_block_scales=True,
+    )
+    out = paged_int8_decode_attention(
+        q, jnp.asarray(kp), jnp.asarray(ksp), jnp.asarray(vp),
+        jnp.asarray(vsp), jnp.asarray(tables), jnp.asarray(lengths),
+    )
+    for s in range(slots):
+        kq, ks, vq, vs = dense[s]
+        L = int(lengths[s])
+        k_deq = kq.astype(np.float32) * ks
+        v_deq = vq.astype(np.float32) * vs
+        ref = xla_attention(
+            q[s:s + 1][:, None], jnp.asarray(k_deq[None, :L]),
+            jnp.asarray(v_deq[None, :L]), causal=True, segment_offset=L - 1,
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(out)[s], np.asarray(ref)[0], atol=1e-4,
+            err_msg=f"slot {s}",
+        )

@@ -25,8 +25,11 @@ import pytest
 from tf_yarn_tpu.serving import (
     FINISH_DEADLINE,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     AdmissionQueue,
+    BlockPool,
+    PrefixCache,
     QueueFull,
     Request,
     SamplingParams,
@@ -277,10 +280,261 @@ def test_close_fails_inflight_requests_as_shutdown():
 
 
 # --------------------------------------------------------------------------
+# paged layout: host-side bookkeeping + a deterministic fake paged engine
+# --------------------------------------------------------------------------
+
+def test_block_pool_refcounts_and_free_list():
+    pool = BlockPool(num_blocks=5, block_size=4)
+    assert pool.free_blocks == 4  # block 0 reserved (trash)
+    a = pool.allocate(2)
+    assert sorted(a) == [1, 2] and pool.used_blocks == 2
+    assert pool.allocate(3) is None  # only 2 left
+    pool.retain([a[0]])
+    assert pool.release([a[0]]) == 0  # still one ref
+    assert pool.release(a) == 2  # both free now
+    assert pool.free_blocks == 4
+    with pytest.raises(ValueError, match="free block"):
+        pool.release([1])
+
+
+def test_prefix_cache_longest_hit_register_and_lru_eviction():
+    pool = BlockPool(num_blocks=9, block_size=4)
+    cache = PrefixCache(pool, capacity=2)
+    prompt = tuple(range(10))
+    ids = pool.allocate(3)  # covers 10 tokens at bs=4 (2 full + partial)
+    # Only FULL blocks are shared: 8 tokens -> 2 blocks, one entry per
+    # whole-block prefix length (k=1 and k=2) so shorter shared
+    # prefixes hit too; block 0 of the prompt is pinned by both.
+    assert cache.register(prompt, 9, ids)
+    assert cache.entries == 2
+    assert cache.cached_blocks == 2
+    assert pool.refcount(ids[0]) == 3 and pool.refcount(ids[2]) == 1
+    # Longest hit capped by max_tokens (must leave >= 1 token to replay).
+    covered, hit = cache.lookup(prompt, max_tokens=len(prompt) - 1)
+    assert covered == 8 and hit == ids[:2]
+    covered, hit = cache.lookup(prompt[:6], max_tokens=5)
+    assert covered == 4 and hit == ids[:1]
+    assert cache.lookup((99, 98, 97, 96), max_tokens=3) == (0, [])
+    assert cache.hits == 2 and cache.misses == 1
+    # The request retires: its own refs go, the cache's survive.
+    pool.release(ids)
+    assert pool.refcount(ids[0]) == 2 and pool.free_blocks == 6
+    # LRU eviction under pressure frees the cached blocks.
+    freed = cache.evict_for(pool.num_blocks - 1)
+    assert freed == 2 and cache.entries == 0
+    assert pool.free_blocks == 8
+
+
+class FakePagedEngine:
+    """The scheduler's PAGED device contract with pure-host state: the
+    pool is a (num_blocks, block_size) int64 token store, gathered by
+    the block table exactly like the real program; a sampled step emits
+    ``(sum of consumed tokens) % 97`` — the same arithmetic as
+    FakeEngine, so a table/length bug changes the emission and fails
+    the stream assertions."""
+
+    def __init__(self, buckets=(4, 8), max_seq_len=32):
+        self.buckets = tuple(sorted(buckets))
+        self.max_seq_len = max_seq_len
+        self.calls = []
+
+    def slot_prefill_len(self, prompt_len):
+        best = 0
+        for bucket in self.buckets:
+            if bucket <= prompt_len - 1:
+                best = bucket
+        return best
+
+    def make_paged_pool(self, params, num_blocks, block_size):
+        self.calls.append(("make_pool", num_blocks, block_size))
+        return np.zeros((num_blocks, block_size), np.int64)
+
+    def prefill(self, params, prompt):
+        self.calls.append(("prefill", prompt.shape))
+        return np.asarray(prompt[0], np.int64), None
+
+    def pack_prefill(self, pool, block_ids, row_cache, prefill_len,
+                     block_size):
+        self.calls.append(("pack", tuple(int(b) for b in block_ids)))
+        pool = pool.copy()
+        for pos in range(prefill_len):
+            block = block_ids[pos // block_size]
+            pool[block, pos % block_size] = row_cache[pos]
+        return pool
+
+    def paged_step(self, params, pool, tables, lengths, tokens, rngs,
+                   sample_mask, block_size, temperature=0.0, top_k=None,
+                   top_p=None):
+        self.calls.append(
+            ("paged_step", tuple(int(t) for t in np.asarray(tokens)),
+             tuple(bool(m) for m in np.asarray(sample_mask)))
+        )
+        pool = np.array(pool)
+        tables = np.asarray(tables)
+        lengths = np.asarray(lengths)
+        emitted = np.array(tokens, np.int32)
+        for s in range(len(tokens)):
+            length = int(lengths[s])
+            # Every slot writes its token at its length — inactive rows
+            # (all-zero table) land in the trash block, like the real
+            # program.
+            pool[tables[s, length // block_size],
+                 length % block_size] = tokens[s]
+            if sample_mask[s]:
+                total = 0
+                for pos in range(length + 1):
+                    total += pool[tables[s, pos // block_size],
+                                  pos % block_size]
+                emitted[s] = total % 97
+        return pool, emitted, rngs
+
+
+def _paged_scheduler(max_slots=2, num_blocks=None, **kwargs):
+    engine = FakePagedEngine()
+    scheduler = SlotScheduler(
+        engine, params=None, max_slots=max_slots, kv_layout="paged",
+        block_size=4, num_blocks=num_blocks, max_seq_len=32, **kwargs,
+    )
+    return engine, scheduler
+
+
+def test_paged_tick_trace_matches_dense_semantics():
+    """Same request as the dense FakeEngine test, through the paged
+    plumbing: identical stream (prefill bucket 4 -> 10, replay 5 -> 15,
+    then 30, 60), with pool/pack calls instead of insert, and NO device
+    evict anywhere — retirement is host-side bookkeeping."""
+    engine, scheduler = _paged_scheduler()
+    response = scheduler.submit(
+        [1, 2, 3, 4, 5], SamplingParams(max_new_tokens=3)
+    )
+    _drive(scheduler, [response])
+    assert response.result(timeout=1) == [15, 30, 60]
+    kinds = [c[0] for c in engine.calls]
+    assert kinds[:3] == ["make_pool", "prefill", "pack"]
+    assert kinds.count("paged_step") == 3
+    assert "evict" not in kinds and "insert" not in kinds
+    # All blocks released on retire (none shareable: prefill 4 = 1 full
+    # block, kept by the prefix cache).
+    stats = scheduler.stats()
+    assert stats["kv_layout"] == "paged"
+    assert stats["block_pool"]["used_blocks"] == \
+        stats["prefix_cache"]["cached_blocks"] == 1
+
+
+def test_paged_admission_holds_until_blocks_free():
+    """Pool pressure: the second request cannot reserve its blocks, so
+    it is HELD (not dropped, not crashing the tick) and admitted on the
+    tick after the first retires and frees them."""
+    # Requests need ceil((5 + 3 - 1)/4) = 2 blocks each; pool holds 3
+    # usable — the second must wait for the first's retirement.
+    engine, scheduler = _paged_scheduler(
+        max_slots=2, num_blocks=4, prefix_cache_capacity=0,
+    )
+    first = scheduler.submit([1, 2, 3, 4, 5],
+                             SamplingParams(max_new_tokens=3))
+    second = scheduler.submit([2, 2, 2, 2, 2],
+                              SamplingParams(max_new_tokens=3))
+    _drive(scheduler, [first, second])
+    assert first.result(timeout=1) == [15, 30, 60]
+    # Same arithmetic as a fresh grid: its cache never saw slot 1's data.
+    assert second.result(timeout=1) == [10, 20, 40]
+    trace = list(scheduler.trace)
+    retire1 = next(t["tick"] for t in trace
+                   if (first.request.id, FINISH_LENGTH) in t["retired"])
+    admit2 = next(t["tick"] for t in trace
+                  if second.request.id in t["admitted"])
+    assert admit2 == retire1 + 1
+    # Both requests decoded correctly with only 3 usable blocks —
+    # dense layout would have needed 2 full slots' worth.
+
+
+def test_paged_prefix_hit_skips_prefill_and_shares_blocks():
+    """Two requests with the same prompt: the second admission does NO
+    prefill/pack device work — its leading table entries are the
+    refcounted shared blocks — and its stream is identical."""
+    engine, scheduler = _paged_scheduler(max_slots=1)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]  # prefill 8 = 2 full blocks
+    first = scheduler.submit(prompt, SamplingParams(max_new_tokens=2))
+    _drive(scheduler, [first])
+    prefills_before = [c for c in engine.calls if c[0] == "prefill"]
+    assert len(prefills_before) == 1
+    second = scheduler.submit(prompt, SamplingParams(max_new_tokens=2))
+    _drive(scheduler, [second])
+    assert [c for c in engine.calls if c[0] == "prefill"] == prefills_before
+    assert second.result(timeout=1) == first.result(timeout=1)
+    stats = scheduler.stats()
+    assert stats["prefix_cache"]["hits"] == 1
+    assert stats["prefix_cache"]["cached_blocks"] == 2
+    from tf_yarn_tpu import telemetry
+
+    assert telemetry.get_registry().counter(
+        "serving/prefix_cache_hits_total"
+    ).value >= 1
+
+
+def test_paged_prefix_eviction_under_pool_pressure():
+    """A cached prefix is evicted (LRU) when a new request needs its
+    blocks — the cache trades reuse for admission, never blocks it."""
+    # Pool: 5 usable blocks. First request: 2 blocks, both full ->
+    # cached on retire. Second (different prompt): needs 4 blocks ->
+    # must evict the cached prefix to fit.
+    engine, scheduler = _paged_scheduler(max_slots=1, num_blocks=6)
+    first = scheduler.submit([1, 2, 3, 4, 5, 6, 7, 8, 9],
+                             SamplingParams(max_new_tokens=2))
+    _drive(scheduler, [first])
+    assert scheduler.stats()["prefix_cache"]["cached_blocks"] == 2
+    second = scheduler.submit([9, 8, 7, 6, 5, 4, 3, 2, 1],
+                              SamplingParams(max_new_tokens=7))
+    _drive(scheduler, [second])
+    stats = scheduler.stats()
+    assert second.finish_reason == FINISH_LENGTH
+    # The old prompt's entries are gone; the new request's own prefix
+    # entries (k=1, k=2) took their place.
+    assert stats["prefix_cache"]["entries"] == 2
+
+
+def test_paged_submit_rejects_impossible_request():
+    _engine, scheduler = _paged_scheduler(max_slots=1, num_blocks=3)
+    with pytest.raises(ValueError, match="KV blocks"):
+        # Needs ceil((9 + 8 - 1)/4) = 4 blocks; the pool holds 2 usable.
+        scheduler.submit(list(range(9)), SamplingParams(max_new_tokens=8))
+
+
+def test_tick_error_fails_inflight_and_loop_survives():
+    """A tick exception must fail the in-flight requests as `error` and
+    leave the scheduler serving — not kill the loop thread."""
+    engine = FakeEngine()
+    scheduler = SlotScheduler(engine, params=None, max_slots=1)
+    boom = {"armed": True}
+    original = engine.step
+
+    def exploding_step(*args, **kwargs):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected device failure")
+        return original(*args, **kwargs)
+
+    engine.step = exploding_step
+    scheduler.start()
+    try:
+        failed = scheduler.submit([1, 2, 3, 4, 5],
+                                  SamplingParams(max_new_tokens=3))
+        failed.result(timeout=30)
+        assert failed.finish_reason == FINISH_ERROR
+        # The loop survived: the next request decodes normally.
+        ok = scheduler.submit([1, 2, 3, 4, 5],
+                              SamplingParams(max_new_tokens=3))
+        assert ok.result(timeout=30) == [15, 30, 60]
+    finally:
+        scheduler.close()
+
+
+# --------------------------------------------------------------------------
 # end-to-end on CPU: real engine, real scheduler loop, real HTTP
 # --------------------------------------------------------------------------
 
-def _tiny_serving_stack(max_slots=2, **scheduler_kwargs):
+def _tiny_serving_stack(max_slots=2, kv_cache_dtype="bf16",
+                        **scheduler_kwargs):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -289,7 +543,8 @@ def _tiny_serving_stack(max_slots=2, **scheduler_kwargs):
     from tf_yarn_tpu.models.decode_engine import DecodeEngine
 
     cfg = transformer.TransformerConfig.tiny(
-        scan_layers=False, remat=False, max_seq_len=64, dtype=jnp.float32
+        scan_layers=False, remat=False, max_seq_len=64, dtype=jnp.float32,
+        kv_cache_dtype=kv_cache_dtype,
     )
     model = transformer.Transformer(cfg)
     params = nn.meta.unbox(
@@ -407,6 +662,180 @@ def test_http_end_to_end_matches_legacy_with_slot_reuse():
         assert telemetry.get_registry().counter(
             "serving/slot_reuse_total"
         ).value >= 1
+    finally:
+        server.stop()
+        scheduler.close()
+
+
+def test_paged_http_end_to_end_matches_legacy_with_prefix_hit():
+    """The paged acceptance bar: concurrent requests through the real
+    HTTP frontend over the PAGED layout — with a pool sized BELOW the
+    dense equivalent — produce token streams bit-identical to
+    generate_legacy; a follow-up request repeating a prompt admits
+    through the prefix cache (no second prefill) and still matches."""
+    model, params, engine, scheduler = _tiny_serving_stack(
+        max_slots=2, kv_layout="paged", block_size=8,
+        # Dense-equivalent would be 2 * 64/8 + 1 = 17; run tighter.
+        num_blocks=11,
+    )
+    scheduler.start()
+    server = ServingServer(scheduler, "127.0.0.1", 0)
+    server.start()
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [
+            rng.randint(0, 256, (5,)).tolist(),
+            rng.randint(0, 256, (9,)).tolist(),
+            rng.randint(0, 256, (3,)).tolist(),
+        ]
+        bodies = [
+            {"prompt": prompts[0], "max_new_tokens": 8},
+            {"prompt": prompts[1], "max_new_tokens": 12},
+            {"prompt": prompts[2], "max_new_tokens": 6},
+        ]
+        results = {}
+
+        def call(index):
+            results[index] = _post(server.port, bodies[index])
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(3)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=300)
+        for index, body in enumerate(bodies):
+            status, _headers, raw = results[index]
+            assert status == 200, raw
+            expected = _legacy_stream(
+                model, params, body["prompt"], body["max_new_tokens"]
+            )
+            assert json.loads(raw)["tokens"] == expected, index
+
+        # Repeat request 1's prompt: its prefill (8 tokens = 1 block at
+        # block_size 8) is in the prefix cache — the admission skips
+        # prefill and the stream stays bit-identical.
+        prefill_calls = engine.stats["prefill_compiles"] \
+            + engine.stats["prefill_cache_hits"]
+        status, _headers, raw = _post(server.port, bodies[1])
+        assert status == 200
+        assert json.loads(raw)["tokens"] == _legacy_stream(
+            model, params, prompts[1], 12
+        )
+        assert (engine.stats["prefill_compiles"]
+                + engine.stats["prefill_cache_hits"]) == prefill_calls
+
+        # /stats exposes the paged telemetry surface.
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=30
+        )
+        conn.request("GET", "/stats")
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+        assert stats["kv_layout"] == "paged"
+        assert stats["kv_cache_hbm_bytes"] > 0
+        assert stats["block_pool"]["num_blocks"] == 11
+        assert stats["prefix_cache"]["hits"] >= 1
+        assert stats["decode_engine"]["paged_step_compiles"] == 1
+    finally:
+        server.stop()
+        scheduler.close()
+
+
+@pytest.mark.parametrize("layout_kwargs", [
+    {},  # dense
+    {"kv_layout": "paged", "block_size": 8},
+])
+def test_whole_prompt_replay_matches_legacy(layout_kwargs):
+    """Regression for the prefill_len == 0 admission path: a prompt
+    shorter than the smallest prompt bucket replays ENTIRELY through
+    the step program from an empty slot — previously untested. Streams
+    must stay bit-equal to generate_legacy, including when the slot was
+    dirtied by an earlier longer request."""
+    model, params, _engine, scheduler = _tiny_serving_stack(
+        max_slots=1, **layout_kwargs
+    )
+    try:
+        # Dirty the single slot first so the replay-from-empty path has
+        # to prove it does not inherit stale cache state.
+        dirty = scheduler.submit([7] * 9, SamplingParams(max_new_tokens=4))
+        for _ in range(400):
+            scheduler.tick()
+            if dirty.done:
+                break
+        prompt = [11, 23]  # len 2 < min bucket 4 -> slot_prefill_len 0
+        response = scheduler.submit(
+            prompt, SamplingParams(max_new_tokens=6)
+        )
+        for _ in range(400):
+            scheduler.tick()
+            if response.done:
+                break
+        assert response.result(timeout=1) == _legacy_stream(
+            model, params, prompt, 6
+        )
+    finally:
+        scheduler.close()
+
+
+def test_paged_int8_serving_matches_int8_legacy():
+    """int8 KV through the paged serving stack: the pool pages the int8
+    values + scales leaves transparently and streams stay bit-equal to
+    the int8 legacy path (int8-vs-fp accuracy itself is bounded by
+    tests/test_decode_engine.py::test_int8_prefill_logits_close_to_fp)."""
+    model, params, _engine, scheduler = _tiny_serving_stack(
+        max_slots=2, kv_cache_dtype="int8", kv_layout="paged",
+        block_size=8,
+    )
+    try:
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, 256, (9,)).tolist(),
+                   rng.randint(0, 256, (5,)).tolist()]
+        responses = [
+            scheduler.submit(p, SamplingParams(max_new_tokens=5))
+            for p in prompts
+        ]
+        for _ in range(400):
+            scheduler.tick()
+            if all(r.done for r in responses):
+                break
+        for prompt, response in zip(prompts, responses):
+            assert response.result(timeout=1) == _legacy_stream(
+                model, params, prompt, 5
+            )
+    finally:
+        scheduler.close()
+
+
+def test_context_overflow_rejected_400_and_loop_survives():
+    """Regression: a prompt + max_new_tokens beyond max_seq_len must be
+    rejected 400 AT ADMISSION — the engine's ValueError used to fire
+    mid-tick inside the scheduler thread and could kill the serving
+    loop. After the rejection the server must still serve."""
+    model, params, _engine, scheduler = _tiny_serving_stack(max_slots=1)
+    scheduler.start()
+    server = ServingServer(scheduler, "127.0.0.1", 0)
+    server.start()
+    try:
+        # max_seq_len is 64: 30 prompt + 40 new = 70 overflows.
+        status, _headers, raw = _post(
+            server.port, {"prompt": [1] * 30, "max_new_tokens": 40}
+        )
+        assert status == 400, raw
+        assert b"context limit" in raw
+        # Direct submits are guarded too (not just the HTTP layer).
+        with pytest.raises(ValueError, match="max_seq_len"):
+            scheduler.submit([1] * 30, SamplingParams(max_new_tokens=40))
+        # The loop is alive: a well-formed request round-trips.
+        prompt = [1, 2, 3]
+        status, _headers, raw = _post(
+            server.port, {"prompt": prompt, "max_new_tokens": 3}
+        )
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == _legacy_stream(
+            model, params, prompt, 3
+        )
     finally:
         server.stop()
         scheduler.close()
@@ -572,6 +1001,17 @@ def test_serving_experiment_validates():
         ServingExperiment(model=None, model_dir="x", queue_capacity=0)
     with pytest.raises(ValueError, match="serve_seconds"):
         ServingExperiment(model=None, model_dir="x", serve_seconds=-1)
+    with pytest.raises(ValueError, match="kv_layout"):
+        ServingExperiment(model=None, model_dir="x", kv_layout="sparse")
+    with pytest.raises(ValueError, match="block_size"):
+        ServingExperiment(model=None, model_dir="x", block_size=0)
+    with pytest.raises(ValueError, match="num_blocks"):
+        ServingExperiment(model=None, model_dir="x", num_blocks=1)
+    with pytest.raises(ValueError, match="prefix_cache_capacity"):
+        ServingExperiment(model=None, model_dir="x",
+                          prefix_cache_capacity=-1)
+    # Paged is the default layout (docs/Serving.md).
+    assert ServingExperiment(model=None, model_dir="x").kv_layout == "paged"
 
 
 # --------------------------------------------------------------------------

@@ -495,6 +495,68 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return fn, args, {}
 
+    def _paged_avals(block_size=8, slots=2, num_blocks=9):
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            _decode_cache_aval,
+            paged_pool_avals,
+        )
+
+        model, params, _prompt, _cache = _engine_avals()
+        row = _decode_cache_aval(model, params)
+        pool = paged_pool_avals(
+            row, num_blocks, block_size, model.config.max_seq_len
+        )
+        max_blocks = model.config.max_seq_len // block_size
+        tables = jax.ShapeDtypeStruct((slots, max_blocks), jnp.int32)
+        lengths = jax.ShapeDtypeStruct((slots,), jnp.int32)
+        return model, params, pool, tables, lengths, slots
+
+    def paged_step():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import build_paged_step_fn
+
+        model, params, pool, tables, lengths, slots = _paged_avals()
+        fn = build_paged_step_fn(
+            model, block_size=8, temperature=0.0, top_k=None, top_p=None
+        )
+        args = (
+            params, pool, tables, lengths,
+            jax.ShapeDtypeStruct((slots,), jnp.int32),     # tokens
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),  # per-slot rngs
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),     # sample mask
+        )
+        return fn, args, {}
+
+    def paged_prefill():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            build_pack_prefill_fn,
+            build_prefill_fn,
+        )
+
+        model, params, pool, _tables, _lengths, _slots = _paged_avals()
+        prefill_fn = build_prefill_fn(model)
+        pack_fn = build_pack_prefill_fn(model, block_size=8, prefill_len=8)
+
+        def prefill_and_pack(params, prompt, pool, block_ids):
+            row_cache, _logits = prefill_fn(params, prompt)
+            return pack_fn(pool, block_ids, row_cache)
+
+        args = (
+            params,
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+            pool,
+            jax.ShapeDtypeStruct((1,), jnp.int32),  # block ids (traced)
+        )
+        return prefill_and_pack, args, {}
+
     return [
         EntryPoint("models.decode_engine.prefill", prefill),
         EntryPoint("models.decode_engine.decode_loop", decode_loop),
@@ -503,6 +565,13 @@ def _decode_entries() -> List[EntryPoint]:
         # callback smuggled in here is a per-token round-trip for every
         # in-flight request at once.
         EntryPoint("models.decode_engine.step", step),
+        # The PAGED serving tick: gather-by-block-table, model step, and
+        # scatter-append all in one program — the acceptance bar is the
+        # same (one compiled program per tick, zero host syncs), now
+        # with table indirection that must also stay on device.
+        EntryPoint("models.decode_engine.paged_step", paged_step),
+        # Paged admission's device work: bucketed prefill + block splice.
+        EntryPoint("models.decode_engine.paged_prefill", paged_prefill),
     ]
 
 

@@ -292,6 +292,14 @@ class ServingExperiment:
     with a 400 (per-request ``max_new_tokens``/``seed``/``eos_token``
     stay free). ``serve_seconds=None`` serves until the task is killed
     or a preemption notice arrives (the normal production posture).
+
+    ``kv_layout`` picks the slot KV storage (docs/Serving.md): "paged"
+    (the default — a global pool of ``block_size``-token KV blocks with
+    per-slot block tables and a shared prompt-prefix cache; fp outputs
+    stay bit-identical to the dense path) or "dense" (one full
+    ``max_seq_len`` cache per slot). ``num_blocks=None`` sizes the pool
+    at dense-equivalent capacity; shrink it to realize the HBM saving
+    (``prefix_cache_capacity=0`` disables prefix sharing).
     """
 
     model: Any
@@ -306,6 +314,10 @@ class ServingExperiment:
     top_p: Optional[float] = None
     step: Optional[int] = None  # checkpoint step; None = latest
     serve_seconds: Optional[float] = None
+    kv_layout: str = "paged"
+    block_size: int = 16
+    num_blocks: Optional[int] = None
+    prefix_cache_capacity: int = 256
 
     def __post_init__(self) -> None:
         if self.max_slots < 1:
@@ -317,6 +329,24 @@ class ServingExperiment:
         if self.serve_seconds is not None and self.serve_seconds <= 0:
             raise ValueError(
                 f"serve_seconds must be > 0 or None, got {self.serve_seconds}"
+            )
+        if self.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got "
+                f"{self.kv_layout!r}"
+            )
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}"
+            )
+        if self.num_blocks is not None and self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 or None, got {self.num_blocks}"
+            )
+        if self.prefix_cache_capacity < 0:
+            raise ValueError(
+                f"prefix_cache_capacity must be >= 0, got "
+                f"{self.prefix_cache_capacity}"
             )
 
 

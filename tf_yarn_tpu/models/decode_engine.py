@@ -33,6 +33,24 @@ The loop-trip-count inputs (actual replay length, max_new_tokens, the
 eos id, the PRNG seed) are traced scalars, so they never force a
 recompile; only shapes and the sampling configuration (temperature /
 top_k / top_p are baked into the traced program) key the cache.
+
+* **Paged KV slots.** The serving grid's dense per-slot caches (each a
+  full `max_seq_len` allocation, mostly padding for short requests) have
+  a paged alternative: ONE global pool of fixed-size KV blocks
+  (`make_paged_pool`) plus a per-slot block table. The compiled
+  `paged_step` gathers each slot's dense cache view from the pool by its
+  block table, runs the exact same per-slot model step, and
+  scatter-appends the new K/V row into the slot's current block — all
+  inside one program, zero host syncs per tick. Because the gathered
+  view holds the identical values the dense slot cache would (positions
+  beyond a slot's length are masked to exactly-zero weight by the
+  attention mask), the fp paged path is BIT-IDENTICAL to the dense path
+  and to `generate_legacy`. Free/allocate is host-side free-list
+  bookkeeping (`serving/paging.py`); there is no per-eviction device
+  program at all. `pack_prefill` splices a bucketed-prefill result into
+  a slot's blocks; int8 KV composes transparently (the pool stores
+  whatever leaves the model's cache has — int8 values + scales
+  included).
 """
 
 from __future__ import annotations
@@ -227,6 +245,218 @@ def build_step_fn(model, temperature: float, top_k: Optional[int],
     return step
 
 
+# --------------------------------------------------------------------------
+# Paged KV layout: pool avals + the compiled gather/scatter programs
+# --------------------------------------------------------------------------
+
+def _seq_axis(shape: Tuple[int, ...], max_seq_len: int) -> Optional[int]:
+    """Index of the cache leaf's sequence axis (the one sized
+    max_seq_len), or None for non-KV leaves (cache_index). Raises on an
+    ambiguous layout — a config where some other cache dimension equals
+    max_seq_len needs a different block_size/max_seq_len split, not a
+    silent guess."""
+    matches = [i for i, dim in enumerate(shape) if dim == max_seq_len]
+    if len(matches) > 1:
+        raise ValueError(
+            f"ambiguous KV cache leaf {shape}: {len(matches)} axes equal "
+            f"max_seq_len={max_seq_len}; the paged layout needs exactly one"
+        )
+    return matches[0] if matches else None
+
+
+def _decode_cache_aval(model, params):
+    """Abstract batch-1 decode cache (the slot row shape). Works with
+    traced or concrete params — eval_shape never touches the device."""
+    return jax.eval_shape(
+        build_prefill_fn(model), params,
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),
+    )[0]
+
+
+def paged_pool_avals(row_aval, num_blocks: int, block_size: int,
+                     max_seq_len: int):
+    """The pool pytree's avals: every KV leaf's seq axis becomes
+    (num_blocks, block_size); index leaves (no seq axis) become None —
+    per-slot positions travel as the step's `lengths` argument instead
+    of living in the cache."""
+    if max_seq_len % block_size:
+        raise ValueError(
+            f"block_size={block_size} must divide max_seq_len={max_seq_len}"
+        )
+
+    def leaf(aval):
+        ax = _seq_axis(aval.shape, max_seq_len)
+        if ax is None:
+            if not jnp.issubdtype(aval.dtype, jnp.integer):
+                raise ValueError(
+                    f"cache leaf {aval.shape}/{aval.dtype} has no "
+                    f"max_seq_len={max_seq_len} axis and is not an index "
+                    "leaf — unknown cache layout for paging"
+                )
+            return None
+        shape = aval.shape[:ax] + (num_blocks, block_size) + aval.shape[ax + 1:]
+        return jax.ShapeDtypeStruct(shape, aval.dtype)
+
+    return jax.tree_util.tree_map(leaf, row_aval)
+
+
+def _is_none(x) -> bool:
+    return x is None
+
+
+def _gather_slot_cache(pool, row_aval, table, length, max_seq_len):
+    """One slot's dense cache view: KV leaves gathered from the pool by
+    the block table (and reshaped back to the dense seq axis), index
+    leaves filled with the slot's length. Values beyond `length` are
+    stale pool garbage — every decode-attention path masks positions >=
+    cache_index to exactly-zero weight, so the view is value-identical
+    to a dense slot cache where it matters (bit-identity relies on
+    this)."""
+
+    def leaf(pool_leaf, aval):
+        if pool_leaf is None:
+            return jnp.full(aval.shape, length, aval.dtype)
+        ax = _seq_axis(aval.shape, max_seq_len)
+        return jnp.take(pool_leaf, table, axis=ax).reshape(aval.shape)
+
+    return jax.tree_util.tree_map(leaf, pool, row_aval, is_leaf=_is_none)
+
+
+def build_paged_step_fn(model, block_size: int, temperature: float,
+                        top_k: Optional[int], top_p: Optional[float]):
+    """The paged continuous-batching step, shared by the engine and the
+    analysis jaxpr entry point (`models.decode_engine.paged_step`).
+
+        fn(params, pool, tables, lengths, tokens, rngs, sample_mask)
+            -> (pool, emitted [S], rngs)
+
+    ONE compiled program advances every slot one token against the
+    global block pool: per slot, gather its dense cache view through its
+    block-table row, run the identical per-slot model step
+    `build_step_fn` runs (same sampling, same RNG discipline — masked
+    slots consume no RNG and pass their token through), then
+    scatter-append the freshly written K/V row into block
+    `table[length // block_size]` at offset `length % block_size`.
+    `tables`/`lengths` are traced values — tick-to-tick table changes
+    never recompile. Inactive slots carry an all-zero table row and
+    length 0, so their (meaningless) write lands in the reserved trash
+    block 0 and can never corrupt a live slot.
+    """
+    max_seq_len = model.config.max_seq_len
+
+    def step(params, pool, tables, lengths, tokens, rngs, sample_mask):
+        row_aval = _decode_cache_aval(model, params)
+
+        def one_slot(table, length, token, rng, do_sample):
+            cache = _gather_slot_cache(
+                pool, row_aval, table, length, max_seq_len
+            )
+            logits, state = model.apply(
+                {**params, "cache": cache}, token[None, None], decode=True,
+                mutable=["cache"],
+            )
+            next_rng, sample_key = jax.random.split(rng)
+            sampled = _sample(
+                logits[:, -1], sample_key, temperature, top_k, top_p
+            )[0]
+            emitted = jnp.where(do_sample, sampled, token)
+            rng = jnp.where(do_sample, next_rng, rng)
+
+            def new_row(leaf, aval):
+                ax = _seq_axis(aval.shape, max_seq_len)
+                if ax is None:
+                    return None
+                return jax.lax.dynamic_slice_in_dim(leaf, length, 1, axis=ax)
+
+            rows = jax.tree_util.tree_map(new_row, state["cache"], row_aval)
+            return emitted, rng, rows
+
+        emitted, rngs, rows = jax.vmap(
+            one_slot, in_axes=(0, 0, 0, 0, 0)
+        )(tables, lengths, tokens, rngs, sample_mask)
+
+        slots = tables.shape[0]
+
+        def write(pool_leaf, slot_rows, aval):
+            if pool_leaf is None:
+                return None
+            ax = _seq_axis(aval.shape, max_seq_len)
+            for s in range(slots):
+                block = tables[s, lengths[s] // block_size]
+                offset = lengths[s] % block_size
+                update = jnp.expand_dims(slot_rows[s], ax)
+                starts = [jnp.asarray(0, jnp.int32)] * pool_leaf.ndim
+                starts[ax] = block
+                starts[ax + 1] = offset
+                pool_leaf = jax.lax.dynamic_update_slice(
+                    pool_leaf, update.astype(pool_leaf.dtype), tuple(starts)
+                )
+            return pool_leaf
+
+        pool_out = jax.tree_util.tree_map(
+            write, pool, rows, row_aval, is_leaf=_is_none
+        )
+        return pool_out, emitted, rngs
+
+    return step
+
+
+def build_pack_prefill_fn(model, block_size: int, prefill_len: int):
+    """The prefill->pool splice program: write positions [0, prefill_len)
+    of a freshly prefilled batch-1 cache into the slot's first
+    ceil(prefill_len / block_size) blocks.
+
+        fn(pool, block_ids, row_cache) -> pool
+
+    `block_ids` values are traced (different slots reuse one compiled
+    program); `prefill_len` is static (one program per prefill bucket).
+    """
+    max_seq_len = model.config.max_seq_len
+    n_pack = -(-prefill_len // block_size)
+
+    def pack(pool, block_ids, row_cache):
+        def leaf(pool_leaf, row_leaf):
+            if pool_leaf is None:
+                return None
+            ax = _seq_axis(row_leaf.shape, max_seq_len)
+            if ax is None:
+                return pool_leaf
+            for j in range(n_pack):
+                width = min(block_size, prefill_len - j * block_size)
+                chunk = jax.lax.slice_in_dim(
+                    row_leaf, j * block_size, j * block_size + width, axis=ax
+                )
+                if width < block_size:
+                    pad = [(0, 0)] * chunk.ndim
+                    pad[ax] = (0, block_size - width)
+                    chunk = jnp.pad(chunk, pad)
+                chunk = jnp.expand_dims(chunk, ax)
+                starts = [jnp.asarray(0, jnp.int32)] * pool_leaf.ndim
+                starts[ax] = block_ids[j]
+                pool_leaf = jax.lax.dynamic_update_slice(
+                    pool_leaf, chunk.astype(pool_leaf.dtype), tuple(starts)
+                )
+            return pool_leaf
+
+        return jax.tree_util.tree_map(
+            leaf, pool, row_cache, is_leaf=_is_none
+        )
+
+    return pack
+
+
+def cache_nbytes(tree) -> int:
+    """Resident bytes of a cache pytree (dense slot grid or paged pool;
+    None leaves — elided index leaves — count zero)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = 1
+        for dim in leaf.shape:
+            size *= dim
+        total += size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
 def _ceil_bucket(value: int, buckets: Tuple[int, ...]) -> Optional[int]:
     for b in sorted(buckets):
         if b >= value:
@@ -280,9 +510,15 @@ class DecodeEngine:
             "prefill_cache_hits": 0,
             "decode_cache_hits": 0,
             "step_cache_hits": 0,
+            "paged_step_compiles": 0,
+            "paged_step_cache_hits": 0,
+            "pack_compiles": 0,
+            "pack_cache_hits": 0,
             "unbucketed_shapes": 0,
             "oversize_batch_chunks": 0,
         }
+        self._paged_step: Dict[tuple, Any] = {}
+        self._pack: Dict[tuple, Any] = {}
 
         # Slot-grid splice helpers (continuous batching): donated, so the
         # grid updates HBM in place instead of copying the whole KV store
@@ -472,6 +708,122 @@ class DecodeEngine:
         )
         with telemetry.span("decode_engine/step", slots=slots):
             return compiled(*step_args)
+
+    # -- paged KV slot API ---------------------------------------------------
+    #
+    # The paged layout (module docstring): a global pool of fixed-size
+    # KV blocks + per-slot block tables, gathered/scattered INSIDE the
+    # compiled programs. The host-side free-list/refcount/prefix
+    # bookkeeping lives in tf_yarn_tpu/serving/paging.py; the scheduler
+    # composes both.
+
+    def make_paged_pool(self, params, num_blocks: int, block_size: int):
+        """Zeroed global KV block pool: every KV leaf of the model's
+        decode cache with its seq axis split into (num_blocks,
+        block_size); index leaves are elided (None) — positions travel
+        as `paged_step`'s traced `lengths`. Block 0 is the reserved
+        trash block (serving/paging.py). Nothing runs on the device
+        except the zeros allocation."""
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved), "
+                f"got {num_blocks}"
+            )
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        avals = paged_pool_avals(
+            _decode_cache_aval(self.model, params), num_blocks, block_size,
+            self.model.config.max_seq_len,
+        )
+        return jax.tree_util.tree_map(
+            lambda aval: (None if aval is None
+                          else jnp.zeros(aval.shape, aval.dtype)),
+            avals, is_leaf=_is_none,
+        )
+
+    def max_blocks_per_slot(self, block_size: int) -> int:
+        """Block-table width: a slot grown to max_seq_len holds exactly
+        this many blocks."""
+        max_seq_len = self.model.config.max_seq_len
+        if max_seq_len % block_size:
+            raise ValueError(
+                f"block_size={block_size} must divide "
+                f"max_seq_len={max_seq_len}"
+            )
+        return max_seq_len // block_size
+
+    def pack_prefill(self, pool, block_ids, row_cache, prefill_len: int,
+                     block_size: int):
+        """Splice a prefilled batch-1 cache's first `prefill_len`
+        positions into `block_ids` (ceil(prefill_len/block_size) ids,
+        traced values — one compiled program per prefill bucket). The
+        pool is donated: HBM updates in place; use the return."""
+        block_ids = jnp.asarray(block_ids, jnp.int32)
+        n_pack = -(-prefill_len // block_size)
+        if block_ids.shape != (n_pack,):
+            raise ValueError(
+                f"pack_prefill needs {n_pack} block ids for "
+                f"prefill_len={prefill_len}, got shape {block_ids.shape}"
+            )
+        key = ("pack", prefill_len, block_size,
+               self._tree_fingerprint(pool))
+        pack_fn = build_pack_prefill_fn(self.model, block_size, prefill_len)
+        args = (pool, block_ids, row_cache)
+        compiled = self._compiled(
+            self._pack, key, "pack",
+            lambda: jax.jit(pack_fn, donate_argnums=(0,))
+            .lower(*args).compile(),
+        )
+        with telemetry.span("decode_engine/pack_prefill",
+                            prefill=prefill_len):
+            return compiled(*args)
+
+    def paged_step(
+        self,
+        params,
+        pool,
+        tables,
+        lengths,
+        tokens,
+        rngs,
+        sample_mask,
+        block_size: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        """Advance every slot one token against the block pool in ONE
+        compiled program (build_paged_step_fn). Compiled once per (grid
+        size, pool shape, block size, sampling config, params
+        fingerprint); tables/lengths/tokens are traced, so per-tick
+        table changes never recompile. The pool and the rng buffer are
+        donated. Returns (pool, emitted [S], rngs)."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        tables = jnp.asarray(tables, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        rngs = jnp.asarray(rngs, jnp.uint32)
+        sample_mask = jnp.asarray(sample_mask, bool)
+        slots = int(tokens.shape[0])
+        key = (slots, tuple(tables.shape), block_size, float(temperature),
+               top_k, top_p, self._params_fingerprint(params),
+               self._tree_fingerprint(pool))
+        step_fn = build_paged_step_fn(
+            self.model, block_size, temperature, top_k, top_p
+        )
+        args = (params, pool, tables, lengths, tokens, rngs, sample_mask)
+        compiled = self._compiled(
+            self._paged_step, key, "paged_step",
+            lambda: jax.jit(step_fn, donate_argnums=(1, 5))
+            .lower(*args).compile(),
+        )
+        with telemetry.span("decode_engine/paged_step", slots=slots):
+            return compiled(*args)
+
+    def _tree_fingerprint(self, tree) -> int:
+        leaves = jax.tree_util.tree_leaves(tree)
+        return hash(tuple(
+            (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
+        ))
 
     # -- the public entry point --------------------------------------------
 

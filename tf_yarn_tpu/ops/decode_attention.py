@@ -22,6 +22,16 @@ Layout choices (the part that makes it fast on TPU):
 Kernel semantics match ``xla_attention(q[:, None], k, v, causal=True,
 segment_offset=length-1)`` for a single query token at position
 ``length - 1`` (tested in tests/test_ops.py).
+
+``paged_int8_decode_attention`` is the same reduction over the PAGED KV
+layout (models/decode_engine.py `make_paged_pool`): the cache arrives as
+a global pool of fixed-size blocks plus a per-slot block table, and the
+kernel walks each slot's table with the table in SMEM (scalar prefetch)
+— physical block ids become pallas index-map coordinates, so the pool
+streams block-by-block with NO gather materializing a dense per-slot
+cache first. Scales may be per-row ([NB, bs, Hkv, 1]) or per-BLOCK
+([NB, 1, Hkv, 1], from `quantize_int8_grouped(group_rows=block_size)`)
+— the per-block layout cuts scale storage/stream by the block size.
 """
 
 from __future__ import annotations
@@ -184,3 +194,174 @@ def int8_decode_attention(
         ),
     )(length, query, kf, ks, vf, vs)
     return out
+
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, ks_ref,
+                         v_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                         n_kv: int, group: int, head_dim: int,
+                         block_size: int, softmax_scale: float):
+    """Grid (slots, blocks-per-slot); logical block axis innermost and
+    sequential. The index maps already routed this invocation's refs to
+    the PHYSICAL block `tables[s, ki]`; in here only the LOGICAL
+    position ``ki * block_size + row`` matters for masking.
+
+    Refs: q (1, H, D); k/v (1, block_size, Hkv*D) int8; scales
+    (1, sb, Hkv) f32 with sb == block_size (per-row) or 1 (per-block —
+    broadcast over the rows). Scratch: m/l (H, 128) f32, acc (H, D) f32.
+    """
+    si = pl.program_id(0)
+    ki = pl.program_id(1)
+    num_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[si]
+    pos = ki * block_size + lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1
+    )
+    live_row = pos < length  # (1, block_size)
+
+    @pl.when(ki * block_size < length)
+    def _step():
+        for h in range(n_kv):
+            k_blk = k_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            v_blk = v_ref[0, :, h * head_dim:(h + 1) * head_dim]
+            scale_k = ks_ref[0, :, h:h + 1]  # (sb, 1): broadcasts sb=1
+            scale_v = vs_ref[0, :, h:h + 1]
+            live_col = live_row[0][:, None]
+            k_f = k_blk.astype(jnp.float32) * scale_k
+            v_f = jnp.where(
+                live_col, v_blk.astype(jnp.float32) * scale_v, 0.0
+            )
+            q_h = q_ref[0, h * group:(h + 1) * group, :].astype(jnp.float32)
+            logits = lax.dot_general(
+                q_h * softmax_scale, k_f, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (group, block_size)
+            logits = jnp.where(live_row, logits, NEG_INF)
+
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m_scr[rows]
+            m_blk = jnp.max(logits, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_blk, m_prev.shape))
+            p = jnp.exp(logits - m_new[:, :1])
+            corr = jnp.exp(m_prev - m_new)
+            m_scr[rows] = m_new
+            l_scr[rows] = l_scr[rows] * corr + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), m_prev.shape
+            )
+            acc_scr[rows] = acc_scr[rows] * corr[:, :1] + lax.dot_general(
+                p, v_f, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+
+
+def paged_int8_decode_attention(
+    query: jax.Array,
+    key_pool: jax.Array,
+    key_scale: jax.Array,
+    value_pool: jax.Array,
+    value_scale: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-token decode attention straight off the paged int8 pool.
+
+    query [S, H, D] (one token per slot), pools [NB, bs, Hkv, D] int8 +
+    scales [NB, sb, Hkv, 1] f32 (sb = bs for per-row scales, 1 for
+    per-block), block_tables [S, MB] int32 (physical block id per
+    logical block; rows beyond a slot's length may point anywhere —
+    those positions are masked), lengths [S] int32 -> [S, H, D] in
+    `query`'s dtype. Per slot s this equals
+    ``int8_decode_attention(q[s:s+1], gathered-dense cache, length[s])``
+    without ever materializing the gathered cache: the block table rides
+    in SMEM (scalar prefetch) and each grid step streams one physical
+    block."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, n_heads, head_dim = query.shape
+    nb, block_size, n_kv, _ = key_pool.shape
+    _, max_blocks = block_tables.shape
+    if query.size == 0 or max_blocks == 0:
+        return jnp.zeros(query.shape, query.dtype)
+    sb = key_scale.shape[1]
+    if sb not in (block_size, 1) or value_scale.shape[1] != sb:
+        raise ValueError(
+            f"scale pools must carry per-row ({block_size}) or per-block "
+            f"(1) scales; got key {key_scale.shape}, value "
+            f"{value_scale.shape}"
+        )
+    group = n_heads // n_kv
+    if softmax_scale is None:
+        softmax_scale = head_dim**-0.5
+    if interpret is None:
+        from tf_yarn_tpu.ops._rowwise import default_interpret
+
+        interpret = default_interpret()
+
+    kf = key_pool.reshape(nb, block_size, n_kv * head_dim)
+    vf = value_pool.reshape(nb, block_size, n_kv * head_dim)
+    ks = key_scale.reshape(nb, sb, n_kv)
+    vs = value_scale.reshape(nb, sb, n_kv)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape((slots,))
+
+    kernel = functools.partial(
+        _paged_decode_kernel, n_kv=n_kv, group=group, head_dim=head_dim,
+        block_size=block_size, softmax_scale=softmax_scale,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, lengths -> SMEM
+        grid=(slots, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, n_heads, head_dim),
+                         lambda si, ki, tables, lengths: (si, 0, 0)),
+            pl.BlockSpec((1, block_size, n_kv * head_dim),
+                         lambda si, ki, tables, lengths:
+                         (tables[si, ki], 0, 0)),
+            pl.BlockSpec((1, sb, n_kv),
+                         lambda si, ki, tables, lengths:
+                         (tables[si, ki], 0, 0)),
+            pl.BlockSpec((1, block_size, n_kv * head_dim),
+                         lambda si, ki, tables, lengths:
+                         (tables[si, ki], 0, 0)),
+            pl.BlockSpec((1, sb, n_kv),
+                         lambda si, ki, tables, lengths:
+                         (tables[si, ki], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_heads, head_dim),
+            lambda si, ki, tables, lengths: (si, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, 128), jnp.float32),
+            pltpu.VMEM((n_heads, head_dim), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, n_heads, head_dim),
+                                       query.dtype),
+        interpret=interpret,
+        compiler_params=(
+            None
+            if interpret
+            else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+        ),
+    )(block_tables, lengths, query, kf, ks, vf, vs)

@@ -88,3 +88,42 @@ def quantize_int8(
 
 def dequantize_int8(values: jax.Array, scales: jax.Array, dtype=jnp.float32):
     return (values.astype(jnp.float32) * scales).astype(dtype)
+
+
+def quantize_int8_grouped(
+    x: jax.Array,
+    group_rows: int,
+    **kwargs,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-GROUP symmetric int8: x [..., R, d] -> (int8 values [..., R, d],
+    f32 scales [..., R/group_rows, 1]) — one abs-max scale shared by every
+    `group_rows` consecutive rows.
+
+    With ``group_rows = kv block size`` this is the paged KV cache's
+    per-block scale layout: 1/group_rows the scale storage (and scale
+    stream traffic) of the per-row layout, traded against a coarser
+    quantization step — the whole block shares its loudest row's scale
+    (see `paged_int8_decode_attention`). Implemented as a reshape around
+    the same pallas kernel: a group of rows IS one long row.
+    """
+    if group_rows < 1:
+        raise ValueError(f"group_rows must be >= 1, got {group_rows}")
+    *lead, rows, d = x.shape
+    if rows % group_rows:
+        raise ValueError(
+            f"rows ({rows}) must divide by group_rows ({group_rows})"
+        )
+    grouped = x.reshape(*lead, rows // group_rows, group_rows * d)
+    values, scales = quantize_int8(grouped, **kwargs)
+    return values.reshape(x.shape), scales
+
+
+def dequantize_int8_grouped(
+    values: jax.Array, scales: jax.Array, group_rows: int,
+    dtype=jnp.float32,
+):
+    """Inverse of `quantize_int8_grouped`: values [..., R, d] + scales
+    [..., R/group_rows, 1] -> [..., R, d]."""
+    *lead, rows, d = values.shape
+    grouped = values.reshape(*lead, rows // group_rows, group_rows * d)
+    return dequantize_int8(grouped, scales, dtype).reshape(values.shape)

@@ -7,7 +7,12 @@ online server (docs/Serving.md):
   bounded admission queue with backpressure, per-request deadlines.
 * :mod:`~tf_yarn_tpu.serving.scheduler` — the slot scheduler: a fixed
   grid of persistent per-slot KV caches, one compiled device step per
-  tick, free-list slot reuse (continuous, not static, batching).
+  tick, free-list slot reuse (continuous, not static, batching). Two KV
+  layouts: dense per-slot caches, or the paged block pool
+  (``kv_layout="paged"``) with int8-transparent storage and a shared
+  prompt-prefix cache.
+* :mod:`~tf_yarn_tpu.serving.paging` — host-side block-pool free list /
+  refcounts and the prefix-cache LRU behind the paged layout.
 * :mod:`~tf_yarn_tpu.serving.server` — the threaded stdlib HTTP
   frontend (``/v1/generate``, ``/healthz``, ``/stats``) and
   `run_serving`, the body of the ``serving`` task type.
@@ -18,9 +23,11 @@ Launch through :func:`tf_yarn_tpu.client.run_on_tpu` with a
 the coordination KV store for discovery.
 """
 
+from tf_yarn_tpu.serving.paging import BlockPool, PrefixCache  # noqa: F401
 from tf_yarn_tpu.serving.request import (  # noqa: F401
     FINISH_DEADLINE,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_SHUTDOWN,
     AdmissionQueue,
@@ -38,10 +45,13 @@ from tf_yarn_tpu.serving.server import (  # noqa: F401
 
 __all__ = [
     "AdmissionQueue",
+    "BlockPool",
     "FINISH_DEADLINE",
     "FINISH_EOS",
+    "FINISH_ERROR",
     "FINISH_LENGTH",
     "FINISH_SHUTDOWN",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "Response",

@@ -29,6 +29,7 @@ FINISH_EOS = "eos"            # the model emitted the request's eos token
 FINISH_LENGTH = "length"      # max_new_tokens generated
 FINISH_DEADLINE = "deadline"  # per-request deadline hit (queued or active)
 FINISH_SHUTDOWN = "shutdown"  # scheduler closed with the request in flight
+FINISH_ERROR = "error"        # a scheduler tick failed with it in flight
 
 
 @dataclasses.dataclass(frozen=True)

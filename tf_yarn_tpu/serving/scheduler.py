@@ -1,29 +1,46 @@
 """Continuous-batching slot scheduler over the compiled decode engine.
 
 The device-facing half of the serving subsystem (docs/Serving.md): a
-fixed grid of ``max_slots`` decode slots, each backed by a persistent
-batch-1 KV cache (`DecodeEngine.make_slot_cache`). Every scheduler tick:
+fixed grid of ``max_slots`` decode slots over one of two KV layouts.
+Every scheduler tick:
 
 1. **retire** active slots whose per-request deadline passed;
 2. **admit** queued requests into free slots — prefill the prompt
    through the engine's existing bucketed prefill programs
    (`slot_prefill_len` picks the largest bucket that leaves the last
-   prompt token for the step program), splice the prefilled KV into the
-   slot (`insert_slot`), and queue the prompt remainder for replay;
-3. **step** ALL slots one token in ONE compiled program
-   (`DecodeEngine.step`): replaying slots force their next prompt token
-   (no RNG consumed — the split chain stays bit-aligned with
-   `generate_legacy`), emitting slots feed back their last token, free
-   slots ride along masked off;
+   prompt token for the step program) and queue the prompt remainder
+   for replay;
+3. **step** ALL slots one token in ONE compiled program: replaying
+   slots force their next prompt token (no RNG consumed — the split
+   chain stays bit-aligned with `generate_legacy`), emitting slots feed
+   back their last token, free slots ride along masked off;
 4. **retire** slots that emitted their eos or hit max_new_tokens,
    pushing their slot back on the free-list — reusable on the very next
    tick, so decode work for in-flight requests never waits for a batch
    to drain (continuous batching, not static batching).
 
+KV layouts (``kv_layout=``):
+
+* ``"dense"`` — each slot owns a full ``max_seq_len`` batch-1 cache
+  inside a stacked grid (`make_slot_cache`/`insert_slot`/`evict_slot`/
+  `step`). Simple, but most of that HBM is padding for short requests
+  and `max_slots` is capped by it.
+* ``"paged"`` — ONE global pool of fixed-size KV blocks
+  (`make_paged_pool`) plus per-slot block tables, gathered/scattered
+  inside the compiled `paged_step`/`pack_prefill` programs. Freeing a
+  slot is O(blocks) host-side free-list bookkeeping
+  (`serving/paging.py`) — no device eviction program at all — and a
+  **prefix cache** maps requests sharing a prompt prefix onto
+  refcounted shared blocks instead of re-running prefill. Admission
+  reserves every block a request can ever need (prompt + max_new - 1
+  tokens) up front, so decode never stalls mid-request; when the pool
+  cannot cover the next request, admission *holds* it (LRU-evicting
+  prefix entries first) until retirements free blocks. The fp paged
+  path is BIT-IDENTICAL to the dense path and `generate_legacy`.
+
 The scheduler is a pure host-side state machine: its only device
-contract is the engine's five slot methods (make_slot_cache / prefill /
-insert_slot / evict_slot / step), so the unit tests drive it with a
-fake engine and assert the tick-by-tick trace deterministically.
+contract is the engine's slot methods, so the unit tests drive it with
+fake engines and assert the tick-by-tick trace deterministically.
 """
 
 from __future__ import annotations
@@ -32,14 +49,16 @@ import collections
 import logging
 import threading
 import time
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.serving.paging import BlockPool, PrefixCache
 from tf_yarn_tpu.serving.request import (
     FINISH_DEADLINE,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_SHUTDOWN,
     AdmissionQueue,
@@ -55,14 +74,17 @@ _logger = logging.getLogger(__name__)
 # deadline-expiry latency for queued-but-idle states.
 IDLE_POLL_S = 0.05
 
+KV_LAYOUTS = ("dense", "paged")
+
 
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
-    __slots__ = ("request", "response", "pending", "last_token", "emitted")
+    __slots__ = ("request", "response", "pending", "last_token", "emitted",
+                 "blocks")
 
     def __init__(self, request: Request, response: Response,
-                 pending: List[int]):
+                 pending: List[int], blocks: Optional[List[int]] = None):
         self.request = request
         self.response = response
         # Prompt tokens still to replay through the step program; the
@@ -70,6 +92,9 @@ class _Slot:
         self.pending: Deque[int] = collections.deque(pending)
         self.last_token = 0
         self.emitted = 0
+        # Paged layout only: the physical block ids this slot holds one
+        # reference on (shared prefix blocks included).
+        self.blocks = blocks
 
 
 class SlotScheduler:
@@ -78,6 +103,14 @@ class SlotScheduler:
     `temperature`/`top_k`/`top_p` configure the ONE compiled step
     program the grid runs; requests whose SamplingParams disagree are
     rejected at submit with ValueError (the HTTP frontend's 400).
+
+    Paged-layout knobs: ``block_size`` tokens per KV block;
+    ``num_blocks`` physical blocks in the pool (default: the
+    dense-equivalent ``max_slots * max_seq_len / block_size + 1`` —
+    shrink it to realize the HBM saving); ``prefix_cache_capacity``
+    entries in the shared-prefix LRU (0 disables prefix sharing);
+    ``max_seq_len`` overrides the engine-derived context bound (fake
+    engines in tests have no model config).
     """
 
     def __init__(
@@ -92,17 +125,26 @@ class SlotScheduler:
         queue_capacity: int = 64,
         retry_after_s: float = 1.0,
         trace_len: int = 4096,
+        kv_layout: str = "dense",
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prefix_cache_capacity: int = 256,
+        max_seq_len: Optional[int] = None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if kv_layout not in KV_LAYOUTS:
+            raise ValueError(
+                f"kv_layout must be one of {KV_LAYOUTS}, got {kv_layout!r}"
+            )
         self.engine = engine
         self.params = params
         self.max_slots = max_slots
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
+        self.kv_layout = kv_layout
         self.queue = AdmissionQueue(queue_capacity, retry_after_s)
-        self._cache = engine.make_slot_cache(params, max_slots)
         self._rngs = np.zeros((max_slots, 2), np.uint32)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._free: Deque[int] = collections.deque(range(max_slots))
@@ -114,13 +156,64 @@ class SlotScheduler:
         self._thread: Optional[threading.Thread] = None
         self._registry = telemetry.get_registry()
         # max context the model's KV cache can hold, when the engine
-        # exposes a config (the fake engines in tests need not).
-        self._max_seq_len = getattr(
-            getattr(engine, "model", None), "config", None
-        )
-        self._max_seq_len = getattr(self._max_seq_len, "max_seq_len", None)
+        # exposes a config (the fake engines in tests need not) or the
+        # caller says so explicitly.
+        if max_seq_len is None:
+            max_seq_len = getattr(
+                getattr(engine, "model", None), "config", None
+            )
+            max_seq_len = getattr(max_seq_len, "max_seq_len", None)
+        self._max_seq_len = max_seq_len
+        # A request the pool could not cover yet: admitted before the
+        # queue on the next tick, once retirements free blocks.
+        self._held: Optional[Tuple[Request, Response]] = None
+
+        if kv_layout == "paged":
+            if self._max_seq_len is None:
+                raise ValueError(
+                    "kv_layout='paged' needs max_seq_len (engine.model."
+                    "config.max_seq_len or the max_seq_len= argument)"
+                )
+            if self._max_seq_len % block_size:
+                raise ValueError(
+                    f"block_size={block_size} must divide "
+                    f"max_seq_len={self._max_seq_len}"
+                )
+            self._block_size = int(block_size)
+            self._blocks_per_slot = self._max_seq_len // self._block_size
+            if num_blocks is None:
+                # Dense-equivalent capacity (+ the trash block); shrink
+                # for the actual HBM saving.
+                num_blocks = max_slots * self._blocks_per_slot + 1
+            self._pool = engine.make_paged_pool(
+                params, num_blocks, self._block_size
+            )
+            self._blocks = BlockPool(num_blocks, self._block_size)
+            self._prefix = PrefixCache(self._blocks, prefix_cache_capacity)
+            self._tables = np.zeros(
+                (max_slots, self._blocks_per_slot), np.int32
+            )
+            self._lengths = np.zeros((max_slots,), np.int32)
+            self._cache = None
+            kv_bytes = _cache_nbytes(self._pool)
+        else:
+            self._cache = engine.make_slot_cache(params, max_slots)
+            self._block_size = None
+            self._blocks = None
+            self._prefix = None
+            kv_bytes = _cache_nbytes(self._cache)
+        self._kv_bytes = kv_bytes
+        self._registry.gauge(
+            "serving/kv_cache_hbm_bytes", layout=kv_layout
+        ).set(kv_bytes)
 
     # -- submission (any thread) -------------------------------------------
+
+    @property
+    def context_limit(self) -> Optional[int]:
+        """Max prompt + max_new_tokens this grid can serve (the slot KV
+        size), or None when unknown (fake engines without a config)."""
+        return self._max_seq_len
 
     def submit(
         self,
@@ -157,6 +250,14 @@ class SlotScheduler:
                 f"({params.max_new_tokens}) exceeds the model's "
                 f"max_seq_len ({self._max_seq_len}) — the slot KV size"
             )
+        if self.kv_layout == "paged":
+            need = self._blocks_needed(request)
+            if need > self._blocks.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self._blocks.num_blocks - 1} — it can never be "
+                    "admitted; raise num_blocks or shorten the request"
+                )
         try:
             response = self.queue.submit(request)
         except Exception:
@@ -166,6 +267,13 @@ class SlotScheduler:
         self._registry.gauge("serving/queue_depth").set(self.queue.depth)
         self._work.set()
         return response
+
+    def _blocks_needed(self, request: Request) -> int:
+        # Cache occupancy over the request's whole lifetime: the prompt
+        # plus every fed-back generated token (the last emitted token is
+        # never fed back, so max_new - 1).
+        total = len(request.prompt) + request.params.max_new_tokens - 1
+        return -(-total // self._block_size)
 
     # -- the tick (scheduler thread) ----------------------------------------
 
@@ -203,6 +311,22 @@ class SlotScheduler:
         )
         self._registry.gauge("serving/free_slots").set(len(self._free))
         self._registry.gauge("serving/queue_depth").set(self.queue.depth)
+        if self.kv_layout == "paged":
+            self._registry.gauge("serving/block_pool_used_blocks").set(
+                self._blocks.used_blocks
+            )
+            self._registry.gauge("serving/block_pool_free_blocks").set(
+                self._blocks.free_blocks
+            )
+            self._registry.gauge("serving/prefix_cache_entries").set(
+                self._prefix.entries
+            )
+            self._registry.gauge("serving/prefix_cache_blocks").set(
+                self._prefix.cached_blocks
+            )
+            self._registry.gauge("serving/prefix_cache_hit_rate").set(
+                self._prefix.hit_rate
+            )
         return worked
 
     def _retire_deadlines(self, now: float, retired: List) -> None:
@@ -211,50 +335,130 @@ class SlotScheduler:
             if state is not None and state.request.expired(now):
                 self._retire(slot, FINISH_DEADLINE, retired)
 
+    def _finish_unadmitted(self, response: Response, reason: str) -> None:
+        """A request that dies without ever occupying a slot."""
+        response._finish(reason)
+        self._registry.counter(
+            "serving/requests_completed_total", reason=reason
+        ).inc()
+
     def _admit(self, now: float, admitted: List[int]) -> None:
         while self._free:
-            item = self.queue.pop()
+            if self._held is not None:
+                item, self._held = self._held, None
+            else:
+                item = self.queue.pop()
             if item is None:
                 break
             request, response = item
             if request.expired(now):
                 # Died in the queue: never occupies a slot.
-                response._finish(FINISH_DEADLINE)
-                self._registry.counter(
-                    "serving/requests_completed_total", reason=FINISH_DEADLINE
-                ).inc()
+                self._finish_unadmitted(response, FINISH_DEADLINE)
                 continue
-            slot = self._free.popleft()
-            self._registry.histogram("serving/queue_wait_seconds").observe(
-                now - request.submitted_at
-            )
-            if self._used_before[slot]:
-                self._registry.counter("serving/slot_reuse_total").inc()
-            self._used_before[slot] = True
-            prefill_len = self.engine.slot_prefill_len(len(request.prompt))
+            if self.kv_layout == "paged":
+                if not self._admit_paged(request, response, now, admitted):
+                    # Pool exhausted: hold the request (FIFO head) until
+                    # retirements free blocks — admission order is
+                    # preserved, decode of in-flight requests continues.
+                    self._held = (request, response)
+                    break
+            else:
+                self._admit_dense(request, response, now, admitted)
+
+    def _record_admission(self, slot: int, request: Request,
+                          now: float, admitted: List[int]) -> None:
+        self._registry.histogram("serving/queue_wait_seconds").observe(
+            now - request.submitted_at
+        )
+        if self._used_before[slot]:
+            self._registry.counter("serving/slot_reuse_total").inc()
+        self._used_before[slot] = True
+        self._rngs[slot] = _prng_key(request.params.seed)
+        admitted.append(request.id)
+        self._registry.counter("serving/requests_admitted_total").inc()
+
+    def _admit_dense(self, request: Request, response: Response,
+                     now: float, admitted: List[int]) -> None:
+        slot = self._free.popleft()
+        prefill_len = self.engine.slot_prefill_len(len(request.prompt))
+        with telemetry.span(
+            "serving/prefill", request=request.id, prefill=prefill_len
+        ):
+            if prefill_len > 0:
+                row_cache, _logits = self.engine.prefill(
+                    self.params,
+                    np.asarray(request.prompt[:prefill_len],
+                               np.int32)[None, :],
+                )
+                self._cache = self.engine.insert_slot(
+                    self._cache, slot, row_cache
+                )
+            else:
+                # Whole prompt replays from an empty cache: the slot
+                # must start from a ZEROED cache_index, not whatever
+                # the previous occupant left behind.
+                self._cache = self.engine.evict_slot(self._cache, slot)
+        self._slots[slot] = _Slot(
+            request, response, list(request.prompt[prefill_len:])
+        )
+        self._record_admission(slot, request, now, admitted)
+
+    def _admit_paged(self, request: Request, response: Response,
+                     now: float, admitted: List[int]) -> bool:
+        """Reserve blocks (sharing a cached prefix when one matches),
+        prefill-or-replay, and install the block table. Returns False —
+        without consuming a slot — when the pool cannot cover the
+        request yet."""
+        prompt = request.prompt
+        n_total = self._blocks_needed(request)
+        # The step consuming the LAST prompt token samples the first
+        # generated token, so at most len(prompt) - 1 tokens may come
+        # from the prefix cache.
+        hit_tokens, hit_ids = self._prefix.lookup(prompt, len(prompt) - 1)
+        if hit_ids:
+            # Protect the matched blocks before any eviction can run.
+            self._blocks.retain(hit_ids)
+        need = n_total - len(hit_ids)
+        if need > self._blocks.free_blocks:
+            self._prefix.evict_for(need)
+        owned = self._blocks.allocate(need)
+        if owned is None:
+            if hit_ids:
+                self._blocks.release(hit_ids)
+            return False
+        blocks = hit_ids + owned
+        slot = self._free.popleft()
+        if hit_tokens:
+            prefill_len = hit_tokens
+            self._registry.counter("serving/prefix_cache_hits_total").inc()
+        else:
+            prefill_len = self.engine.slot_prefill_len(len(prompt))
             with telemetry.span(
                 "serving/prefill", request=request.id, prefill=prefill_len
             ):
                 if prefill_len > 0:
                     row_cache, _logits = self.engine.prefill(
                         self.params,
-                        np.asarray(request.prompt[:prefill_len],
-                                   np.int32)[None, :],
+                        np.asarray(prompt[:prefill_len], np.int32)[None, :],
                     )
-                    self._cache = self.engine.insert_slot(
-                        self._cache, slot, row_cache
+                    n_pack = -(-prefill_len // self._block_size)
+                    self._pool = self.engine.pack_prefill(
+                        self._pool,
+                        np.asarray(blocks[:n_pack], np.int32),
+                        row_cache, prefill_len, self._block_size,
                     )
-                else:
-                    # Whole prompt replays from an empty cache: the slot
-                    # must start from a ZEROED cache_index, not whatever
-                    # the previous occupant left behind.
-                    self._cache = self.engine.evict_slot(self._cache, slot)
-            self._slots[slot] = _Slot(
-                request, response, list(request.prompt[prefill_len:])
-            )
-            self._rngs[slot] = _prng_key(request.params.seed)
-            admitted.append(request.id)
-            self._registry.counter("serving/requests_admitted_total").inc()
+                    # Offer the full-block prefix for sharing; the
+                    # partial tail block stays private (the replay
+                    # writes it).
+                    self._prefix.register(prompt, prefill_len, blocks)
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        self._lengths[slot] = prefill_len
+        self._slots[slot] = _Slot(
+            request, response, list(prompt[prefill_len:]), blocks=blocks
+        )
+        self._record_admission(slot, request, now, admitted)
+        return True
 
     def _step(self, active: List[int], retired: List) -> None:
         tokens = np.zeros((self.max_slots,), np.int32)
@@ -267,10 +471,20 @@ class SlotScheduler:
             else:
                 tokens[slot] = state.last_token
                 mask[slot] = True
-        self._cache, emitted, rngs = self.engine.step(
-            self.params, self._cache, tokens, self._rngs, mask,
-            temperature=self.temperature, top_k=self.top_k, top_p=self.top_p,
-        )
+        if self.kv_layout == "paged":
+            self._pool, emitted, rngs = self.engine.paged_step(
+                self.params, self._pool, self._tables, self._lengths,
+                tokens, self._rngs, mask,
+                block_size=self._block_size,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p,
+            )
+        else:
+            self._cache, emitted, rngs = self.engine.step(
+                self.params, self._cache, tokens, self._rngs, mask,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p,
+            )
         # The tick's one host sync: every slot's token in one transfer.
         emitted = np.asarray(emitted)
         # np.array (copy): admissions write PRNGKey rows into this
@@ -278,6 +492,11 @@ class SlotScheduler:
         self._rngs = np.array(rngs)
         for slot in active:
             state = self._slots[slot]
+            if self.kv_layout == "paged":
+                # Every active slot consumed one token this tick (a
+                # replayed prompt token or its fed-back emission) and
+                # wrote its K/V at the old length.
+                self._lengths[slot] += 1
             sampled = bool(mask[slot])
             if state.pending:
                 state.pending.popleft()
@@ -303,6 +522,16 @@ class SlotScheduler:
         state = self._slots[slot]
         self._slots[slot] = None
         self._free.append(slot)
+        if self.kv_layout == "paged":
+            # O(blocks) bookkeeping, no device program: shared prefix
+            # blocks survive (the prefix cache holds its own reference),
+            # exclusively-owned blocks return to the free list. The
+            # stale pool content needs no zeroing — gathers mask
+            # positions beyond each slot's length, and reallocation
+            # overwrites.
+            self._blocks.release(state.blocks)
+            self._tables[slot, :] = 0
+            self._lengths[slot] = 0
         state.response._finish(reason)
         retired.append((state.request.id, reason))
         self._registry.counter(
@@ -325,9 +554,33 @@ class SlotScheduler:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            if not self.tick():
+            try:
+                worked = self.tick()
+            except Exception:
+                # A tick must never kill the serving loop (a malformed
+                # request slipping past admission used to): fail the
+                # in-flight work visibly and keep serving new requests.
+                _logger.exception(
+                    "scheduler tick failed; failing in-flight requests"
+                )
+                self._registry.counter("serving/tick_errors_total").inc()
+                self._fail_inflight(FINISH_ERROR)
+                continue
+            if not worked:
                 self._work.wait(IDLE_POLL_S)
                 self._work.clear()
+
+    def _fail_inflight(self, reason: str) -> None:
+        if self._held is not None:
+            _request, response = self._held
+            self._held = None
+            self._finish_unadmitted(response, reason)
+        for _request, response in self.queue.drain():
+            self._finish_unadmitted(response, reason)
+        retired: List = []
+        for slot in range(self.max_slots):
+            if self._slots[slot] is not None:
+                self._retire(slot, reason, retired)
 
     def close(self) -> None:
         """Stop the loop; fail queued and in-flight requests as
@@ -337,14 +590,7 @@ class SlotScheduler:
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
-        for _request, response in self.queue.drain():
-            response._finish(FINISH_SHUTDOWN)
-        for slot in range(self.max_slots):
-            state = self._slots[slot]
-            if state is not None:
-                self._slots[slot] = None
-                self._free.append(slot)
-                state.response._finish(FINISH_SHUTDOWN)
+        self._fail_inflight(FINISH_SHUTDOWN)
 
     # -- introspection -------------------------------------------------------
 
@@ -360,11 +606,38 @@ class SlotScheduler:
             "temperature": self.temperature,
             "top_k": self.top_k,
             "top_p": self.top_p,
+            "kv_layout": self.kv_layout,
+            "kv_cache_hbm_bytes": self._kv_bytes,
         }
+        if self.kv_layout == "paged":
+            snap["block_size"] = self._block_size
+            snap["block_pool"] = {
+                "num_blocks": self._blocks.num_blocks,
+                "used_blocks": self._blocks.used_blocks,
+                "free_blocks": self._blocks.free_blocks,
+            }
+            snap["prefix_cache"] = {
+                "entries": self._prefix.entries,
+                "cached_blocks": self._prefix.cached_blocks,
+                "hits": self._prefix.hits,
+                "misses": self._prefix.misses,
+                "hit_rate": round(self._prefix.hit_rate, 4),
+            }
         engine_stats = getattr(self.engine, "stats", None)
         if isinstance(engine_stats, dict):
             snap["decode_engine"] = dict(engine_stats)
         return snap
+
+
+def _cache_nbytes(tree) -> int:
+    """Resident bytes of a cache pytree; tolerates fake engines' plain
+    numpy (or scalar-free) stand-ins."""
+    try:
+        from tf_yarn_tpu.models.decode_engine import cache_nbytes
+
+        return cache_nbytes(tree)
+    except Exception:
+        return 0
 
 
 def _prng_key(seed: int) -> np.ndarray:

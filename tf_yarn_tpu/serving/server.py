@@ -139,6 +139,23 @@ def _make_handler(scheduler: SlotScheduler):
             except (KeyError, TypeError, ValueError) as exc:
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
+            # Context-overflow rejection AT ADMISSION: a prompt +
+            # max_new_tokens beyond the slot KV size can never decode —
+            # the engine's ValueError would otherwise first fire
+            # mid-tick inside the scheduler thread. 400 here keeps the
+            # serving loop untouched.
+            limit = scheduler.context_limit
+            if limit is not None and (
+                len(prompt) + params.max_new_tokens > limit
+            ):
+                self._json(400, {
+                    "error": (
+                        f"prompt ({len(prompt)}) + max_new_tokens "
+                        f"({params.max_new_tokens}) exceeds this server's "
+                        f"context limit ({limit})"
+                    ),
+                })
+                return
             timeout_s = body.get("timeout_s")
             try:
                 response = scheduler.submit(
@@ -251,6 +268,10 @@ def run_serving(experiment, runtime=None) -> dict:
         top_p=experiment.top_p,
         queue_capacity=experiment.queue_capacity,
         retry_after_s=experiment.retry_after_s,
+        kv_layout=experiment.kv_layout,
+        block_size=experiment.block_size,
+        num_blocks=experiment.num_blocks,
+        prefix_cache_capacity=experiment.prefix_cache_capacity,
     )
     server = ServingServer(scheduler, experiment.host, experiment.port)
     scheduler.start()

@@ -377,6 +377,18 @@ def _stale_tpu_fields() -> dict:
                 "paged_int8_vs_dense_slots_per_gb"):
         if key in serve:
             fields[f"last_tpu_serve_{key}"] = serve[key]
+    fleet = table.get("fleet") or {}
+    for row_name, row in (fleet.get("rows") or {}).items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            fields[f"last_tpu_fleet_{row_name}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            fields[f"last_tpu_fleet_{row_name}_ttft_p95_ms"] = row.get(
+                "ttft_p95_ms"
+            )
+    for key, value in fleet.items():
+        if str(key).startswith("scaling_"):
+            fields[f"last_tpu_fleet_{key}"] = value
     return fields
 
 
@@ -576,7 +588,7 @@ def bench_flagship_train():
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "rows": table,
     }
-    for section in ("decode", "long_context", "serve", "bert_base",
+    for section in ("decode", "long_context", "serve", "fleet", "bert_base",
                     "resnet50", "vit_base"):
         if previous.get(section):
             ab[section] = {
@@ -647,6 +659,27 @@ def bench_flagship_train():
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
+        try:
+            fleet = suite.bench_fleet(tpu=True)
+            ab["fleet"] = fleet
+            _write_ab(ab)
+            # Fleet scale-out headline: aggregate tokens/s + tail TTFT
+            # through the router per replica count, plus the scaling
+            # ratios vs one replica (ROADMAP item 1's named bench).
+            for row_name, row in (fleet.get("rows") or {}).items():
+                if isinstance(row, dict) and "tokens_per_sec" in row:
+                    result[f"fleet_{row_name}_tokens_per_sec"] = row[
+                        "tokens_per_sec"
+                    ]
+                    result[f"fleet_{row_name}_ttft_p95_ms"] = row.get(
+                        "ttft_p95_ms"
+                    )
+            for key, value in fleet.items():
+                if str(key).startswith("scaling_"):
+                    result[f"fleet_{key}"] = value
+            _log(f"fleet: {fleet}")
+        except Exception as exc:
+            _log(f"fleet bench FAILED: {type(exc).__name__}: {exc}")
         try:
             longctx = suite.bench_long_context(tpu=True)
             # Fresh measurement replaces any carried-forward stale section.

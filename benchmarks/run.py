@@ -740,6 +740,210 @@ def bench_serve(tpu: bool):
     }
 
 
+def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
+    """Fleet mode of the serve bench: aggregate tokens/s and TTFT p95
+    vs replica count under the SAME seeded Poisson arrival trace,
+    driven end-to-end through the fleet ROUTER (tf_yarn_tpu/fleet/):
+    N real serving stacks (scheduler + HTTP frontend) advertise into an
+    in-process KV, the replica registry probes them healthy, and every
+    request streams through the router's ``/v1/generate`` passthrough —
+    TTFT is measured client-side at first token line, so discovery,
+    balancing, and the extra hop are all inside the number. The decode
+    engine (and its compiled programs) is shared across replicas, so
+    the sweep measures the replica axis, not recompilation."""
+    import threading
+    import time
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet import ReplicaRegistry, RouterServer, make_policy
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.serving import SamplingParams, ServingServer, SlotScheduler
+
+    select_devices()
+    if tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            scan_layers=False,
+        )
+        default_requests, max_slots, mean_gap_s = 32, 8, 0.02
+        prompt_lens, max_new_range = (64, 128, 256), (32, 256)
+    else:
+        config = TransformerConfig.tiny(scan_layers=False, max_seq_len=64)
+        default_requests, max_slots, mean_gap_s = 12, 4, 0.005
+        prompt_lens, max_new_range = (5, 9, 14), (2, 16)
+    n_requests = n_requests or default_requests
+    model = Transformer(config)
+    rng = np.random.RandomState(0)
+    params = nn.meta.unbox(
+        model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, max(prompt_lens)), jnp.int32),
+        )
+    )
+    engine = DecodeEngine(model)
+
+    # The bench_serve seeded Poisson trace, shared by every fleet size.
+    gaps = rng.exponential(mean_gap_s, n_requests)
+    arrivals = np.cumsum(gaps)
+    requests = [
+        (
+            float(arrivals[i]),
+            rng.randint(0, config.vocab_size,
+                        rng.choice(prompt_lens)).tolist(),
+            int(rng.randint(*max_new_range)),
+        )
+        for i in range(n_requests)
+    ]
+    total_tokens = sum(m for _, _, m in requests)
+
+    def stream_through_router(port, offset, prompt, max_new, t0, out):
+        import http.client
+        import json as json_lib
+
+        lag = t0 + offset - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        try:
+            conn.request(
+                "POST", "/v1/generate",
+                json_lib.dumps({"prompt": prompt,
+                                "max_new_tokens": max_new,
+                                "stream": True}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            first = None
+            n_tokens = 0
+            # Read to EOF (not just the done line): draining the
+            # terminal chunk means the router has finished its own
+            # accounting for this request before we count it done.
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                payload = json_lib.loads(line)
+                if "token" in payload:
+                    if first is None:
+                        first = time.perf_counter()
+                    n_tokens += 1
+            out.append({
+                "status": resp.status,
+                "n_tokens": n_tokens,
+                "ttft_s": (first - (t0 + offset))
+                if first is not None else None,
+            })
+        finally:
+            conn.close()
+
+    def run_fleet(n_replicas):
+        kv = InProcessKV()
+        replicas = []
+        for index in range(n_replicas):
+            scheduler = SlotScheduler(
+                engine, params, max_slots=max_slots,
+                queue_capacity=n_requests,
+            )
+            scheduler.start()
+            server = ServingServer(scheduler, "127.0.0.1", 0)
+            server.start()
+            task = f"serving:{index}"
+            event.serving_endpoint_event(kv, task, server.endpoint)
+            replicas.append((task, scheduler, server))
+        registry = ReplicaRegistry(
+            kv, tasks=[task for task, _, _ in replicas],
+            probe_interval_s=0.2,
+        )
+        registry.refresh(force=True)
+        router = RouterServer(
+            registry, make_policy("least_loaded"), "127.0.0.1", 0,
+            retries=2,
+        )
+        router.start()
+        try:
+            # Warmup compiles every prompt bucket's prefill + the step
+            # program outside the timed window (shared engine: paid
+            # once across the whole sweep).
+            for length in prompt_lens:
+                replicas[0][1].submit(
+                    [1] * length, SamplingParams(max_new_tokens=2)
+                ).result(timeout=600)
+            results = []
+            threads = []
+            t0 = time.perf_counter()
+            for offset, prompt, max_new in requests:
+                thread = threading.Thread(
+                    target=stream_through_router,
+                    args=(router.port, offset, prompt, max_new, t0,
+                          results),
+                )
+                thread.start()
+                threads.append(thread)
+            for thread in threads:
+                thread.join(timeout=900)
+            wall = time.perf_counter() - t0
+            completed = [r for r in results if r["status"] == 200]
+            ttfts = sorted(
+                r["ttft_s"] for r in completed if r["ttft_s"] is not None
+            )
+            generated = sum(r["n_tokens"] for r in completed)
+            row = {
+                "replicas": n_replicas,
+                "completed": len(completed),
+                "tokens_per_sec": round(generated / wall, 2),
+                "wall_s": round(wall, 3),
+            }
+            if ttfts:
+                row["ttft_mean_ms"] = round(
+                    1000 * sum(ttfts) / len(ttfts), 2
+                )
+                row["ttft_p95_ms"] = round(
+                    1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 2
+                )
+            router_stats = router.stats()
+            row["healthy_replicas"] = router_stats["healthy_replicas"]
+            row["routed_ok"] = sum(
+                outcomes.get("ok", 0)
+                for outcomes in router_stats["routed_requests"].values()
+            )
+            return row
+        finally:
+            router.stop()
+            for _task, scheduler, server in replicas:
+                server.stop()
+                scheduler.close()
+
+    rows = {}
+    for count in replica_counts:
+        try:
+            rows[f"r{count}"] = run_fleet(count)
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            rows[f"r{count}"] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    result = {
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "total_max_new_tokens": total_tokens,
+        "rows": rows,
+    }
+    base = rows.get(f"r{replica_counts[0]}", {}).get("tokens_per_sec")
+    for count in replica_counts[1:]:
+        top = rows.get(f"r{count}", {}).get("tokens_per_sec")
+        if base and top:
+            result[f"scaling_r{count}_vs_r{replica_counts[0]}"] = round(
+                top / base, 3
+            )
+    return result
+
+
 def bench_ici_allreduce(tpu: bool):
     from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -760,6 +964,7 @@ CONFIGS = {
     "long_context": bench_long_context,
     "decode": bench_decode,
     "serve": bench_serve,
+    "fleet": bench_fleet,
     "ici_allreduce": bench_ici_allreduce,
 }
 

@@ -6,6 +6,11 @@ type runs through the launcher), fires a burst of concurrent HTTP
 requests with mixed prompt/output lengths, and prints each stream plus
 the scheduler's tick trace — watch a slot freed by a short request get
 re-admitted while longer requests are still decoding.
+
+`python examples/serving_example.py fleet` runs the FLEET variant
+instead (docs/Fleet.md): two replicas behind a router task — requests
+go through the router's identical `/v1/generate`, then one replica is
+killed and the survivor keeps serving (health ejection + failover).
 """
 
 import http.client
@@ -94,5 +99,95 @@ def main() -> None:
     scheduler.close()
 
 
+def fleet() -> None:
+    """Two serving replicas behind a fleet router (docs/Fleet.md):
+    discovery through the KV endpoint events, health-probed admission,
+    least-loaded balancing, and kill-one-replica failover — all the
+    pieces `fleet_topology` launches, in one process."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet import ReplicaRegistry, RouterServer, make_policy
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.serving import ServingServer, SlotScheduler
+
+    config = TransformerConfig.tiny(max_seq_len=64, scan_layers=False)
+    model = Transformer(config)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    )
+    # One engine shared by both replicas: compiles are paid once.
+    engine = DecodeEngine(
+        model, batch_buckets=(1, 2, 4), prompt_buckets=(4, 8, 16)
+    )
+    kv = InProcessKV()
+    replicas = []
+    for index in range(2):
+        scheduler = SlotScheduler(engine, params, max_slots=2)
+        scheduler.start()
+        server = ServingServer(scheduler, "127.0.0.1", 0)
+        server.start()
+        task = f"serving:{index}"
+        # The discovery protocol the launcher's serving tasks speak.
+        event.serving_endpoint_event(kv, task, server.endpoint)
+        replicas.append((task, scheduler, server))
+        print(f"replica {task} on {server.endpoint}")
+
+    registry = ReplicaRegistry(
+        kv, tasks=[task for task, _, _ in replicas], probe_interval_s=0.2
+    )
+    registry.refresh(force=True)
+    router = RouterServer(
+        registry, make_policy("least_loaded"), "127.0.0.1", 0, retries=3
+    )
+    router.start()
+    print(f"router on {router.endpoint} "
+          f"({len(registry.healthy())} replicas healthy)")
+
+    def ask(tag):
+        rng = np.random.RandomState(hash(tag) % 2**16)
+        body = {"prompt": rng.randint(0, 256, 5).tolist(),
+                "max_new_tokens": 6}
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", router.port, timeout=300
+        )
+        conn.request(
+            "POST", "/v1/generate", json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        reply = json.loads(conn.getresponse().read())
+        conn.close()
+        print(f"  {tag}: {reply['tokens']} ({reply['finish_reason']})")
+
+    print("\nfour requests through the router:")
+    for index in range(4):
+        ask(f"request {index}")
+    print("routed:", router.stats()["routed_requests"])
+
+    task0, scheduler0, server0 = replicas[0]
+    print(f"\nkilling {task0} — the fleet keeps serving:")
+    server0.stop()
+    scheduler0.close()
+    for index in range(3):
+        ask(f"after-kill {index}")
+    stats = router.stats()
+    print("routed:", stats["routed_requests"])
+    print("replica states:",
+          {t: r["state"] for t, r in stats["replicas"].items()})
+
+    router.stop()
+    for _task, scheduler, server in replicas[1:]:
+        server.stop()
+        scheduler.close()
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        fleet()
+    else:
+        main()

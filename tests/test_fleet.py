@@ -1,0 +1,886 @@
+"""Serving fleet: replica registry, balancing policies, router task.
+
+Three layers, matching the subsystem's seams:
+
+* **Policies** are pure selection over replica lists — driven with a
+  fake registry and asserted deterministically.
+* **The registry** is a host-side state machine over the coordination
+  KV plus an injectable ``/healthz`` probe — the discovery-race tests
+  (endpoint advertised before the replica is healthy, beat-then-silent
+  heartbeats, draining, tombstones, KV flakes) run with fake probes and
+  an in-process KV, no HTTP in sight.
+* **The router** forwards over real HTTP — fake upstream replicas pin
+  the failover wire behavior (429 → another replica, connect error →
+  eject + another replica, mid-stream death → classified error line,
+  empty fleet → 503 + Retry-After), and the end-to-end test holds the
+  acceptance bar: two REAL serving replicas behind one router produce
+  streams bit-identical to `generate_legacy`, and killing one replica
+  mid-run ejects it while subsequent requests succeed on the survivor.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu import event
+from tf_yarn_tpu.coordination.kv import InProcessKV
+from tf_yarn_tpu.fleet import (
+    EJECTED,
+    HEALTHY,
+    PENDING,
+    STOPPED,
+    LeastLoadedPolicy,
+    Replica,
+    ReplicaRegistry,
+    RoundRobinPolicy,
+    RouterServer,
+    make_policy,
+)
+from tf_yarn_tpu.resilience.taxonomy import FailureKind
+
+
+# --------------------------------------------------------------------------
+# balancing policies on a fake registry
+# --------------------------------------------------------------------------
+
+class FakeRegistry:
+    """The policies' registry contract: just a healthy set."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def healthy(self):
+        return [r for r in self.replicas if r.state == HEALTHY]
+
+
+def _replica(task, load=0, state=HEALTHY):
+    replica = Replica(task, endpoint=f"127.0.0.1:{9000}")
+    replica.state = state
+    replica.queue_depth = load
+    return replica
+
+
+def test_round_robin_policy_cycles_deterministically():
+    registry = FakeRegistry(
+        [_replica("serving:1"), _replica("serving:0"), _replica("serving:2")]
+    )
+    policy = RoundRobinPolicy()
+    picks = [policy.pick(registry.healthy()).task for _ in range(6)]
+    # Task order, cycling, regardless of the list order handed in.
+    assert picks == ["serving:0", "serving:1", "serving:2"] * 2
+    # Exclusion re-maps the cycle over the remaining candidates.
+    assert policy.pick(
+        registry.healthy(), exclude={"serving:0", "serving:2"}
+    ).task == "serving:1"
+    assert policy.pick(
+        registry.healthy(), exclude={"serving:0", "serving:1", "serving:2"}
+    ) is None
+
+
+def test_least_loaded_policy_picks_min_load_and_tiebreaks():
+    a = _replica("serving:0", load=3)
+    b = _replica("serving:1", load=1)
+    c = _replica("serving:2", load=1)
+    registry = FakeRegistry([a, b, c])
+    policy = LeastLoadedPolicy()
+    # Min load wins; ties break by task order (deterministic).
+    assert policy.pick(registry.healthy()).task == "serving:1"
+    # The router's in-flight count feeds the load signal between polls.
+    b.inflight = 5
+    assert policy.pick(registry.healthy()).task == "serving:2"
+    assert policy.pick(
+        registry.healthy(), exclude={"serving:2"}
+    ).task == "serving:0"
+    assert policy.pick(registry.healthy(),
+                       exclude={r.task for r in (a, b, c)}) is None
+
+
+def test_make_policy_names_and_unknown():
+    assert make_policy("round_robin").name == "round_robin"
+    assert make_policy("least_loaded").name == "least_loaded"
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        make_policy("random")
+
+
+# --------------------------------------------------------------------------
+# replica registry: discovery races, ejection, re-admission
+# --------------------------------------------------------------------------
+
+class ProbeScript:
+    """An injectable /healthz probe the tests steer per endpoint."""
+
+    def __init__(self):
+        self.responses = {}  # endpoint -> dict | Exception
+
+    def set(self, endpoint, response):
+        self.responses[endpoint] = response
+
+    def __call__(self, endpoint):
+        response = self.responses.get(
+            endpoint, ConnectionRefusedError(f"no probe script for {endpoint}")
+        )
+        if isinstance(response, Exception):
+            raise response
+        return dict(response)
+
+
+OK = {"status": "ok", "queue_depth": 0, "active_slots": 0}
+
+
+def test_registry_holds_admission_until_first_healthy_probe():
+    """The discovery race: the endpoint event lands BEFORE the replica
+    answers /healthz (it is still compiling) — the registry must keep it
+    out of rotation until the first healthy probe, without counting the
+    cold probes as ejections."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7001")
+    # tasks=None: discovery by KV scan, the launcher-less mode.
+    registry = ReplicaRegistry(kv, probe=probe, probe_interval_s=0.0)
+    probe.set("127.0.0.1:7001", ConnectionRefusedError("still booting"))
+    assert registry.refresh(force=True) == []
+    replica = registry.get("serving:0")
+    assert replica.state == PENDING and replica.ejections == 0
+    # Several cold polls change nothing.
+    registry.refresh(force=True)
+    assert registry.get("serving:0").state == PENDING
+    # First healthy probe admits it; that is an admission, NOT a
+    # re-admission.
+    probe.set("127.0.0.1:7001", OK)
+    healthy = registry.refresh(force=True)
+    assert [r.task for r in healthy] == ["serving:0"]
+    assert registry.get("serving:0").readmissions == 0
+
+
+def test_registry_ejects_unreachable_and_readmits_on_recovery():
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7002")
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0
+    )
+    probe.set("127.0.0.1:7002", OK)
+    assert len(registry.refresh(force=True)) == 1
+    probe.set("127.0.0.1:7002", ConnectionResetError("gone"))
+    assert registry.refresh(force=True) == []
+    replica = registry.get("serving:0")
+    assert replica.state == EJECTED
+    assert replica.eject_reason == "unreachable"
+    assert replica.ejections == 1
+    probe.set("127.0.0.1:7002", OK)
+    assert len(registry.refresh(force=True)) == 1
+    assert replica.state == HEALTHY and replica.readmissions == 1
+    snap = registry.snapshot()
+    assert snap["ejections_total"] == 1
+    assert snap["readmissions_total"] == 1
+    from tf_yarn_tpu import telemetry
+
+    metrics = telemetry.get_registry()
+    assert metrics.counter(
+        "fleet/replica_ejections_total", reason="unreachable"
+    ).value >= 1
+    assert metrics.counter("fleet/replica_readmissions_total").value >= 1
+    assert metrics.gauge("fleet/healthy_replicas").value == 1
+
+
+def test_registry_ejects_draining_replica_before_socket_dies():
+    """The preemption-drain handoff: /healthz still answers (the socket
+    is alive) but reports "draining" — the registry must eject NOW, not
+    when the connection finally refuses."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7003")
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0
+    )
+    probe.set("127.0.0.1:7003", OK)
+    registry.refresh(force=True)
+    probe.set("127.0.0.1:7003", {**OK, "status": "draining"})
+    assert registry.refresh(force=True) == []
+    replica = registry.get("serving:0")
+    assert replica.state == EJECTED and replica.eject_reason == "draining"
+
+
+def test_registry_heartbeat_silence_ejects_tombstone_stops():
+    """Beat-then-silent ejects even while /healthz still answers (a
+    wedged scheduler thread can keep a socket alive — the watchdog
+    posture); a fresh beat re-admits; the clean-stop tombstone removes
+    the replica as finished, never as dead."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7004")
+    probe.set("127.0.0.1:7004", OK)
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0,
+        dead_heartbeat_s=5.0,
+    )
+    # Never-beat is not flagged (it may still be restoring/compiling).
+    assert len(registry.refresh(force=True)) == 1
+    event.heartbeat_event(kv, "serving:0", timestamp=time.time() - 60.0)
+    assert registry.refresh(force=True) == []
+    replica = registry.get("serving:0")
+    assert replica.state == EJECTED
+    assert replica.eject_reason == "heartbeat_silent"
+    event.heartbeat_event(kv, "serving:0")  # recovery: beating again
+    assert len(registry.refresh(force=True)) == 1
+    assert replica.readmissions == 1
+    event.heartbeat_stopped_event(kv, "serving:0")
+    assert registry.refresh(force=True) == []
+    assert replica.state == STOPPED
+    assert replica.ejections == 1  # finishing is not an ejection
+
+
+def test_registry_kv_flake_keeps_previous_state():
+    class FlakyKV:
+        def __init__(self, kv):
+            self._kv = kv
+            self.fail = False
+
+        def get_str(self, key):
+            if self.fail:
+                raise ConnectionError("coordination link down")
+            return self._kv.get_str(key)
+
+        def keys(self, prefix=""):
+            return self._kv.keys(prefix)
+
+    inner = InProcessKV()
+    kv = FlakyKV(inner)
+    probe = ProbeScript()
+    event.serving_endpoint_event(inner, "serving:0", "127.0.0.1:7005")
+    probe.set("127.0.0.1:7005", OK)
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0
+    )
+    assert len(registry.refresh(force=True)) == 1
+    kv.fail = True
+    # One flaky poll degrades the view, it does not evict the fleet.
+    assert len(registry.refresh(force=True)) == 1
+    assert registry.get("serving:0").state == HEALTHY
+
+
+def test_registry_report_failure_ejects_immediately():
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7006")
+    probe.set("127.0.0.1:7006", OK)
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=3600.0
+    )
+    registry.refresh(force=True)
+    registry.report_failure("serving:0", ConnectionResetError("mid-request"))
+    replica = registry.get("serving:0")
+    assert replica.state == EJECTED
+    assert replica.eject_reason == "request_transient"
+    assert registry.healthy() == []
+    # The probe clock was cleared: the next (rate-limited) refresh
+    # probes for recovery immediately instead of in an hour.
+    assert replica.last_probe_at is None
+    assert len(registry.refresh()) == 1
+
+
+# --------------------------------------------------------------------------
+# router over fake upstream replicas: the failover wire behavior
+# --------------------------------------------------------------------------
+
+def _fake_upstream(generate):
+    """A minimal replica: /healthz ok, POST /v1/generate delegated to
+    `generate(handler, body)`. Returns (httpd, endpoint)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _json(self, status, payload, headers=()):
+            body = (json.dumps(payload) + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._json(200, {"status": "ok", "queue_depth": 0,
+                             "active_slots": 0})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            generate(self, body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def _canned_ok(tokens):
+    def generate(handler, body):
+        handler._json(200, {"tokens": list(tokens),
+                            "finish_reason": "length",
+                            "request_id": 0, "ttft_s": 0.001})
+
+    return generate
+
+
+def _always_busy(retry_after=3):
+    def generate(handler, body):
+        handler._json(
+            429, {"error": "queue full", "retry_after_s": retry_after},
+            headers=(("Retry-After", str(retry_after)),),
+        )
+
+    return generate
+
+
+def _abrupt_streamer(n_lines=2):
+    def generate(handler, body):
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/jsonl")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        for index in range(n_lines):
+            data = (json.dumps({"token": index}) + "\n").encode()
+            handler.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            handler.wfile.flush()
+        # Die mid-stream: FIN without the terminating chunk — the
+        # router's readline raises, exactly like a killed replica.
+        handler.connection.shutdown(socket.SHUT_WR)
+        handler.close_connection = True
+
+    return generate
+
+
+def _registry_over(endpoints, **kwargs):
+    """A registry whose probes are scripted healthy for `endpoints`
+    (task -> endpoint)."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    for task, endpoint in endpoints.items():
+        event.serving_endpoint_event(kv, task, endpoint)
+        probe.set(endpoint, OK)
+    registry = ReplicaRegistry(
+        kv, tasks=sorted(endpoints), probe=probe,
+        probe_interval_s=kwargs.pop("probe_interval_s", 0.0), **kwargs,
+    )
+    registry.refresh(force=True)
+    return registry, probe
+
+
+def _post(port, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/v1/generate", json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def test_router_fails_over_429_to_another_replica():
+    busy_httpd, busy_ep = _fake_upstream(_always_busy(retry_after=3))
+    ok_httpd, ok_ep = _fake_upstream(_canned_ok([5, 6, 7]))
+    registry, _probe = _registry_over(
+        {"serving:0": busy_ep, "serving:1": ok_ep}
+    )
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=2,
+    )
+    router.start()
+    try:
+        status, _headers, raw = _post(
+            router.port, {"prompt": [1, 2], "max_new_tokens": 3}
+        )
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == [5, 6, 7]
+        stats = router.stats()
+        assert stats["routed_requests"]["serving:0"]["busy"] == 1
+        assert stats["routed_requests"]["serving:1"]["ok"] == 1
+        from tf_yarn_tpu import telemetry
+
+        assert telemetry.get_registry().counter(
+            "fleet/routed_requests_total",
+            replica="serving:1", outcome="ok",
+        ).value >= 1
+    finally:
+        router.stop()
+        busy_httpd.shutdown()
+        ok_httpd.shutdown()
+
+
+def test_router_connect_error_fails_over_and_ejects():
+    # A dead endpoint: bind a port, then close it so connections refuse.
+    probe_sock = socket.socket()
+    probe_sock.bind(("127.0.0.1", 0))
+    dead_port = probe_sock.getsockname()[1]
+    probe_sock.close()
+    ok_httpd, ok_ep = _fake_upstream(_canned_ok([9]))
+    registry, _probe = _registry_over(
+        {"serving:0": f"127.0.0.1:{dead_port}", "serving:1": ok_ep}
+    )
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=2,
+    )
+    router.start()
+    try:
+        status, _headers, raw = _post(
+            router.port, {"prompt": [1], "max_new_tokens": 1}
+        )
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == [9]
+        # The dead replica was ejected by the observed failure: the next
+        # request routes straight to the survivor.
+        assert [r.task for r in registry.healthy()] == ["serving:1"]
+        assert registry.get("serving:0").state == EJECTED
+        status, _headers, raw = _post(
+            router.port, {"prompt": [2], "max_new_tokens": 1}
+        )
+        assert status == 200
+        stats = router.stats()
+        assert stats["routed_requests"]["serving:0"]["connect_error"] == 1
+        assert stats["routed_requests"]["serving:1"]["ok"] == 2
+    finally:
+        router.stop()
+        ok_httpd.shutdown()
+
+
+def test_router_503_with_retry_after_when_no_replica_healthy():
+    kv = InProcessKV()
+    probe = ProbeScript()  # nothing advertised, nothing healthy
+    registry = ReplicaRegistry(kv, tasks=[], probe=probe)
+    router = RouterServer(
+        registry, make_policy("least_loaded"), "127.0.0.1", 0,
+        retries=1, retry_after_s=2.0,
+    )
+    router.start()
+    try:
+        status, headers, raw = _post(
+            router.port, {"prompt": [1], "max_new_tokens": 1}
+        )
+        assert status == 503, raw
+        assert headers.get("Retry-After") == "2"
+        payload = json.loads(raw)
+        assert payload["retry_after_s"] == 2.0
+        assert "no serving replica" in payload["error"]
+        assert router.stats()["routed_requests"]["-"]["no_replica"] == 1
+    finally:
+        router.stop()
+
+
+def test_router_midstream_death_classified_and_next_request_reroutes():
+    """The mid-stream ejection race: the 200 is on the wire when the
+    replica dies, so the stream must END with a classified error line
+    (no silent truncation, no retry garbling the token stream), the
+    replica must be ejected, and the NEXT request must route to the
+    survivor."""
+    dying_httpd, dying_ep = _fake_upstream(_abrupt_streamer(n_lines=2))
+    ok_httpd, ok_ep = _fake_upstream(_canned_ok([4, 2]))
+    registry, _probe = _registry_over(
+        {"serving:0": dying_ep, "serving:1": ok_ep}
+    )
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=2,
+    )
+    router.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": [1, 2], "max_new_tokens": 8,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines = [json.loads(line) for line in resp.read().splitlines()]
+        conn.close()
+        # The two tokens that made it, then the classified error line.
+        assert [l["token"] for l in lines if "token" in l] == [0, 1]
+        tail = lines[-1]
+        assert tail["done"] and tail["finish_reason"] == "error"
+        assert tail["failure_kind"] in {k.value for k in FailureKind}
+        assert "serving:0" in tail["error"]
+        # Ejected by the observed failure; the next request reroutes.
+        assert registry.get("serving:0").state == EJECTED
+        status, _headers, raw = _post(
+            router.port, {"prompt": [1], "max_new_tokens": 2}
+        )
+        assert status == 200
+        assert json.loads(raw)["tokens"] == [4, 2]
+        assert router.stats()["routed_requests"]["serving:0"][
+            "stream_error"] == 1
+    finally:
+        router.stop()
+        dying_httpd.shutdown()
+        ok_httpd.shutdown()
+
+
+def test_router_passes_deterministic_4xx_through_verbatim():
+    def bad_request(handler, body):
+        handler._json(400, {"error": "prompt too long"})
+
+    bad_httpd, bad_ep = _fake_upstream(bad_request)
+    registry, _probe = _registry_over({"serving:0": bad_ep})
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=3,
+    )
+    router.start()
+    try:
+        status, _headers, raw = _post(
+            router.port, {"prompt": [1] * 999, "max_new_tokens": 1}
+        )
+        # A user error is FATAL_USER-shaped: passed through, not retried
+        # into every replica.
+        assert status == 400
+        assert json.loads(raw)["error"] == "prompt too long"
+        assert router.stats()["routed_requests"]["serving:0"][
+            "upstream_400"] == 1
+    finally:
+        router.stop()
+        bad_httpd.shutdown()
+
+
+def test_router_healthz_and_stats_surface():
+    ok_httpd, ok_ep = _fake_upstream(_canned_ok([1]))
+    registry, _probe = _registry_over({"serving:0": ok_ep})
+    router = RouterServer(
+        registry, make_policy("least_loaded"), "127.0.0.1", 0,
+    )
+    router.start()
+    try:
+        status, health = _get(router.port, "/healthz")
+        assert status == 200
+        assert health["status"] == "ok"
+        assert health["role"] == "router"
+        assert health["healthy_replicas"] == 1
+        status, stats = _get(router.port, "/stats")
+        assert status == 200
+        assert stats["policy"] == "least_loaded"
+        assert stats["healthy_replicas"] == 1
+        assert stats["replicas"]["serving:0"]["state"] == HEALTHY
+        assert "routed_requests" in stats
+        assert stats["ejections_total"] == 0
+    finally:
+        router.stop()
+        ok_httpd.shutdown()
+
+
+# --------------------------------------------------------------------------
+# the router task body (tasks/router.py drives run_router)
+# --------------------------------------------------------------------------
+
+def test_run_router_task_body_advertises_and_routes():
+    from tf_yarn_tpu import preemption
+    from tf_yarn_tpu.experiment import ServingExperiment
+    from tf_yarn_tpu.fleet.router import run_router
+    from tf_yarn_tpu.topologies import TaskInstance, TaskKey
+
+    upstream_httpd, upstream_ep = _fake_upstream(_canned_ok([3, 1, 4]))
+    kv = InProcessKV()
+    event.serving_endpoint_event(kv, "serving:0", upstream_ep)
+    event.heartbeat_event(kv, "serving:0")
+
+    class _Runtime:
+        pass
+
+    runtime = _Runtime()
+    runtime.kv = kv
+    runtime.task_key = TaskKey("router", 0)
+    runtime.task = "router:0"
+    runtime.cluster_tasks = [
+        TaskInstance(TaskKey("serving", 0), 1),
+        TaskInstance(TaskKey("router", 0), 1),
+    ]
+    experiment = ServingExperiment(
+        model=None, model_dir="/unused-router-never-restores",
+        router_host="127.0.0.1", router_probe_interval_s=0.05,
+        router_policy="round_robin",
+    )
+    result = {}
+
+    def route():
+        result["stats"] = run_router(experiment, runtime=runtime)
+
+    thread = threading.Thread(target=route)
+    thread.start()
+    try:
+        endpoint = kv.wait_str("router:0/router_endpoint", timeout=60)
+        port = int(endpoint.rsplit(":", 1)[1])
+        status, _headers, raw = _post(
+            port, {"prompt": [1, 2], "max_new_tokens": 3}
+        )
+        assert status == 200
+        assert json.loads(raw)["tokens"] == [3, 1, 4]
+        status, stats = _get(port, "/stats")
+        assert stats["healthy_replicas"] == 1
+        assert stats["routed_requests"]["serving:0"]["ok"] == 1
+    finally:
+        preemption.request()  # the drain flag run_router polls
+        thread.join(timeout=60)
+        preemption.reset()
+        upstream_httpd.shutdown()
+    assert not thread.is_alive()
+    assert result["stats"]["endpoint"].endswith(str(port))
+    assert result["stats"]["policy"] == "round_robin"
+
+
+# --------------------------------------------------------------------------
+# launcher wiring
+# --------------------------------------------------------------------------
+
+def test_router_task_type_wiring():
+    from tf_yarn_tpu import _env
+    from tf_yarn_tpu.backends import PRIMARY_TASK_TYPES
+    from tf_yarn_tpu.topologies import (
+        NodeLabel,
+        TaskSpec,
+        check_topology,
+        fleet_topology,
+    )
+
+    assert _env.gen_task_module("router") == "tf_yarn_tpu.tasks.router"
+    assert (
+        _env.gen_task_module("router", "my.custom.module")
+        == "my.custom.module"
+    )
+    # A crashed router must fail (and relaunch) the run.
+    assert "router" in PRIMARY_TASK_TYPES
+    specs = fleet_topology(nb_replicas=3, chips_per_host=1)
+    assert specs["serving"].instances == 3
+    assert specs["router"].instances == 1
+    assert specs["router"].label is NodeLabel.CPU
+    # A router with zero serving replicas can never serve: reject at
+    # topology build, not at 3am when the fleet launches empty.
+    with pytest.raises(ValueError, match="at least one serving replica"):
+        check_topology({
+            "chief": TaskSpec(instances=1, chips_per_host=1,
+                              label=NodeLabel.TPU),
+            "router": TaskSpec(instances=1),
+        })
+    with pytest.raises(ValueError, match="cannot reserve chips"):
+        check_topology({
+            "serving": TaskSpec(instances=1, chips_per_host=1,
+                                label=NodeLabel.TPU),
+            "router": TaskSpec(instances=1, chips_per_host=1,
+                               label=NodeLabel.TPU),
+        })
+
+
+def test_serving_experiment_router_knobs_validate():
+    from tf_yarn_tpu.experiment import ServingExperiment
+
+    assert ServingExperiment(
+        model=None, model_dir="x"
+    ).router_policy == "least_loaded"
+    with pytest.raises(ValueError, match="router_policy"):
+        ServingExperiment(model=None, model_dir="x", router_policy="random")
+    with pytest.raises(ValueError, match="router_retries"):
+        ServingExperiment(model=None, model_dir="x", router_retries=-1)
+    with pytest.raises(ValueError, match="router_probe_interval_s"):
+        ServingExperiment(model=None, model_dir="x",
+                          router_probe_interval_s=0)
+
+
+# --------------------------------------------------------------------------
+# end-to-end on CPU: 2 REAL serving replicas + 1 router
+# --------------------------------------------------------------------------
+
+def _tiny_fleet(n_replicas=2, max_slots=2):
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.models import transformer
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.serving import ServingServer, SlotScheduler
+
+    cfg = transformer.TransformerConfig.tiny(
+        scan_layers=False, remat=False, max_seq_len=64, dtype=jnp.float32
+    )
+    model = transformer.Transformer(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    )
+    # ONE engine shared by all replicas: compiled programs are per
+    # (shape, config), so the fleet pays each compile once.
+    engine = DecodeEngine(
+        model, batch_buckets=(1, 2, 4), prompt_buckets=(4, 8, 16)
+    )
+    kv = InProcessKV()
+    replicas = []
+    for index in range(n_replicas):
+        scheduler = SlotScheduler(engine, params, max_slots=max_slots)
+        scheduler.start()
+        server = ServingServer(scheduler, "127.0.0.1", 0)
+        server.start()
+        task = f"serving:{index}"
+        event.serving_endpoint_event(kv, task, server.endpoint)
+        event.heartbeat_event(kv, task)
+        replicas.append({"task": task, "scheduler": scheduler,
+                         "server": server})
+    registry = ReplicaRegistry(
+        kv, tasks=[r["task"] for r in replicas], probe_interval_s=0.05
+    )
+    registry.refresh(force=True)
+    return model, params, kv, replicas, registry
+
+
+def _legacy_stream(model, params, prompt, max_new, eos=None):
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.models.generate import generate_legacy
+
+    out = generate_legacy(
+        model, params, jnp.asarray([prompt], jnp.int32), max_new,
+        temperature=0.0, eos_token=eos,
+    )
+    row = np.asarray(out)[0, len(prompt):].tolist()
+    if eos is not None and eos in row:
+        row = row[:row.index(eos) + 1]
+    return row
+
+
+def test_fleet_end_to_end_matches_legacy_and_survives_replica_kill():
+    """The acceptance bar: 2 real serving replicas + 1 router on CPU.
+    Concurrent requests THROUGH the router return streams bit-identical
+    to `generate_legacy`; killing one replica mid-run ejects it and
+    every subsequent request succeeds on the survivor."""
+    model, params, _kv, replicas, registry = _tiny_fleet(n_replicas=2)
+    assert len(registry.healthy()) == 2
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=3,
+    )
+    router.start()
+    try:
+        rng = np.random.RandomState(7)
+        prompts = [
+            rng.randint(0, 256, (5,)).tolist(),
+            rng.randint(0, 256, (9,)).tolist(),
+            rng.randint(0, 256, (3,)).tolist(),
+            rng.randint(0, 256, (6,)).tolist(),
+        ]
+        bodies = [
+            {"prompt": prompts[0], "max_new_tokens": 6},
+            {"prompt": prompts[1], "max_new_tokens": 8},
+            {"prompt": prompts[2], "max_new_tokens": 4},
+            {"prompt": prompts[3], "max_new_tokens": 5},
+        ]
+        results = {}
+
+        def call(index):
+            results[index] = _post(router.port, bodies[index], timeout=300)
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(4)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=600)
+        for index, body in enumerate(bodies):
+            status, _headers, raw = results[index]
+            assert status == 200, raw
+            assert json.loads(raw)["tokens"] == _legacy_stream(
+                model, params, body["prompt"], body["max_new_tokens"]
+            ), index
+        # Both replicas actually served (round-robin over 4 requests).
+        routed = router.stats()["routed_requests"]
+        assert routed["serving:0"]["ok"] >= 1
+        assert routed["serving:1"]["ok"] >= 1
+
+        # Streaming through the router: chunked lines, bit-identical.
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=300)
+        conn.request(
+            "POST", "/v1/generate",
+            json.dumps({"prompt": prompts[0], "max_new_tokens": 6,
+                        "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        lines = [json.loads(line) for line in resp.read().splitlines()]
+        conn.close()
+        assert [l["token"] for l in lines if "token" in l] == \
+            _legacy_stream(model, params, prompts[0], 6)
+        assert lines[-1]["done"] and lines[-1]["finish_reason"] == "length"
+
+        # KILL replica 0: its frontend refuses connections from here on.
+        replicas[0]["server"].stop()
+        replicas[0]["scheduler"].close()
+        # Subsequent requests all succeed on the survivor — the first
+        # may transit the dead replica (connect error -> failover +
+        # ejection), later ones route straight to serving:1.
+        for body in bodies[:3]:
+            status, _headers, raw = _post(router.port, body, timeout=300)
+            assert status == 200, raw
+            assert json.loads(raw)["tokens"] == _legacy_stream(
+                model, params, body["prompt"], body["max_new_tokens"]
+            )
+        assert [r.task for r in registry.healthy()] == ["serving:1"]
+        assert registry.get("serving:0").state == EJECTED
+        stats = router.stats()
+        assert stats["ejections_total"] >= 1
+        assert stats["routed_requests"]["serving:1"]["ok"] >= 3
+    finally:
+        router.stop()
+        for replica in replicas[1:]:
+            replica["server"].stop()
+            replica["scheduler"].close()
+
+
+# --------------------------------------------------------------------------
+# the fleet bench reports aggregate throughput per replica count
+# --------------------------------------------------------------------------
+
+def test_bench_fleet_reports_scaling_rows():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tpu_yarn_bench_suite_fleet_test",
+        os.path.join(repo, "benchmarks", "run.py"),
+    )
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+    result = suite.bench_fleet(
+        tpu=False, replica_counts=(1, 2), n_requests=3
+    )
+    rows = result["rows"]
+    for name in ("r1", "r2"):
+        assert name in rows, result
+        assert rows[name].get("error") is None, rows[name]
+        assert rows[name]["completed"] == 3
+        assert rows[name]["tokens_per_sec"] > 0
+        assert rows[name]["routed_ok"] == 3
+        assert "ttft_p95_ms" in rows[name]
+    assert rows["r2"]["healthy_replicas"] == 2
+    # The scaling ratio is REPORTED (its value is rig-dependent: on one
+    # shared CPU the replicas contend, on real chips they scale).
+    assert "scaling_r2_vs_r1" in result

@@ -922,6 +922,57 @@ def test_http_streaming_backpressure_health_and_stats():
         scheduler.close()
 
 
+def test_healthz_reports_draining_not_ok_after_drain_notice():
+    """Regression: /healthz kept answering {"status": "ok"} after the
+    preemption-drain notice fired — the window where a load balancer
+    (the fleet router's registry) keeps routing to a replica about to
+    vanish. Both drain signals must flip it: the scheduler's drain flag
+    (run_serving sets it on its poll) and the preemption flag itself
+    (visible the instant the signal lands, before any poll)."""
+    from tf_yarn_tpu import preemption
+
+    def healthz(port):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    engine = FakeEngine()
+    scheduler = SlotScheduler(engine, params=None, max_slots=1)
+    server = ServingServer(scheduler, "127.0.0.1", 0)
+    server.start()
+    try:
+        status, health = healthz(server.port)
+        assert status == 200 and health["status"] == "ok"
+        assert scheduler.stats()["draining"] is False
+        scheduler.drain()
+        status, health = healthz(server.port)
+        assert status == 200 and health["status"] == "draining"
+        assert scheduler.stats()["draining"] is True
+    finally:
+        server.stop()
+        scheduler.close()
+
+    # The raw preemption flag flips /healthz too — no poll loop needed.
+    engine = FakeEngine()
+    scheduler = SlotScheduler(engine, params=None, max_slots=1)
+    server = ServingServer(scheduler, "127.0.0.1", 0)
+    server.start()
+    try:
+        assert healthz(server.port)[1]["status"] == "ok"
+        preemption.request()
+        try:
+            assert healthz(server.port)[1]["status"] == "draining"
+        finally:
+            preemption.reset()
+    finally:
+        server.stop()
+        scheduler.close()
+
+
 def test_run_serving_task_body_advertises_and_serves(monkeypatch):
     """The serving task body end-to-end: restore (patched), engine,
     scheduler, frontend, KV endpoint advertisement, preemption-drain

@@ -13,6 +13,7 @@ WORKER_MODULE = "tf_yarn_tpu.tasks.worker"
 TENSORBOARD_MODULE = "tf_yarn_tpu.tasks.tensorboard"
 EVALUATOR_MODULE = "tf_yarn_tpu.tasks.evaluator"
 SERVING_MODULE = "tf_yarn_tpu.tasks.serving"
+ROUTER_MODULE = "tf_yarn_tpu.tasks.router"
 
 
 def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) -> str:
@@ -22,4 +23,6 @@ def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) ->
         return EVALUATOR_MODULE
     if task_type == "serving":
         return custom_task_module or SERVING_MODULE
+    if task_type == "router":
+        return custom_task_module or ROUTER_MODULE
     return custom_task_module or WORKER_MODULE

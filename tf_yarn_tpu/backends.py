@@ -44,8 +44,9 @@ KILLED = "KILLED"
 # Side-cars don't gate run completion: the reference's evaluator and
 # tensorboard self-terminate after the training tasks stop
 # (evaluator_task.py:21-35, _tensorboard_task.py:54-58). Serving tasks
-# ARE primary: a crashed server fails (and relaunches) the run.
-PRIMARY_TASK_TYPES = ("chief", "worker", "serving")
+# ARE primary: a crashed server fails (and relaunches) the run — and so
+# is the fleet router, the one endpoint every client dials.
+PRIMARY_TASK_TYPES = ("chief", "worker", "serving", "router")
 
 
 @dataclass

@@ -307,6 +307,16 @@ def _execute_and_await_termination(
             )
             for key in cluster.handle.tasks()
             if key.type == "serving"
+        ]
+        # And the fleet router's — the one endpoint clients dial in a
+        # fleet topology (tf_yarn_tpu.fleet).
+        + [
+            (
+                event.router_endpoint_event_name(key.to_kv_str()),
+                "router endpoint",
+            )
+            for key in cluster.handle.tasks()
+            if key.type == "router"
         ],
         n_try,
     )

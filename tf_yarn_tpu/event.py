@@ -48,6 +48,11 @@ METRICS = "metrics"
 # advertises its HTTP endpoint so clients and the driver find it
 # through the KV store instead of guessing ports.
 SERVING_ENDPOINT = "serving_endpoint"
+# Fleet-router discovery (tf_yarn_tpu.fleet): the router task advertises
+# ITS endpoint the same way — the one address clients actually dial in a
+# fleet topology (the serving endpoints behind it stay advertised too,
+# for direct access and for the router's own registry).
+ROUTER_ENDPOINT = "router_endpoint"
 
 
 def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
@@ -141,6 +146,17 @@ def serving_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
 
 def serving_endpoint_event_name(task: str) -> str:
     return f"{task}/{SERVING_ENDPOINT}"
+
+
+def router_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
+    """Advertise the fleet router's HTTP endpoint (``host:port``): the
+    single address clients dial in a fleet topology (docs/Fleet.md);
+    the driver logs it once at launch."""
+    broadcast(kv, f"{task}/{ROUTER_ENDPOINT}", endpoint)
+
+
+def router_endpoint_event_name(task: str) -> str:
+    return f"{task}/{ROUTER_ENDPOINT}"
 
 
 def metrics_event(kv: KVStore, task: str, payload: str) -> None:

@@ -318,6 +318,17 @@ class ServingExperiment:
     block_size: int = 16
     num_blocks: Optional[int] = None
     prefix_cache_capacity: int = 256
+    # Fleet-router knobs (tf_yarn_tpu/fleet/, docs/Fleet.md), read only
+    # by the ``router`` task in a `fleet_topology` — serving replicas
+    # ignore them. ``router_policy`` picks the balancing policy
+    # ("round_robin" or "least_loaded"); ``router_retries`` budgets the
+    # per-request failover loop (connect errors / 429s move to another
+    # replica); ``router_probe_interval_s`` paces /healthz probes.
+    router_host: str = "0.0.0.0"
+    router_port: int = 0
+    router_policy: str = "least_loaded"
+    router_retries: int = 2
+    router_probe_interval_s: float = 1.0
 
     def __post_init__(self) -> None:
         if self.max_slots < 1:
@@ -347,6 +358,20 @@ class ServingExperiment:
             raise ValueError(
                 f"prefix_cache_capacity must be >= 0, got "
                 f"{self.prefix_cache_capacity}"
+            )
+        if self.router_policy not in ("round_robin", "least_loaded"):
+            raise ValueError(
+                f"router_policy must be 'round_robin' or 'least_loaded', "
+                f"got {self.router_policy!r}"
+            )
+        if self.router_retries < 0:
+            raise ValueError(
+                f"router_retries must be >= 0, got {self.router_retries}"
+            )
+        if self.router_probe_interval_s <= 0:
+            raise ValueError(
+                f"router_probe_interval_s must be > 0, got "
+                f"{self.router_probe_interval_s}"
             )
 
 

@@ -1,0 +1,54 @@
+"""Serving fleet: router + replica registry for multi-replica serving.
+
+The scale-out layer over `tf_yarn_tpu/serving/` (docs/Fleet.md): N
+independent ``serving`` replicas stay exactly as PR 5–6 built them —
+same step programs, same HTTP surface — and this package adds the
+framework-owned placement TF-Replicator argues for (PAPERS.md):
+
+* :mod:`~tf_yarn_tpu.fleet.registry` — the live replica set, built from
+  the KV ``{task}/serving_endpoint`` advertisements and
+  ``{task}/heartbeat`` beats the serving tasks already publish, with
+  ``/healthz``-probe health ejection (hold-until-healthy admission,
+  draining-aware, re-admission on recovery).
+* :mod:`~tf_yarn_tpu.fleet.policy` — balancing policies: round-robin
+  and least-loaded (cached ``/healthz`` occupancy + router in-flight).
+* :mod:`~tf_yarn_tpu.fleet.router` — the router HTTP task: the same
+  ``/v1/generate`` (streaming passthrough) / ``/healthz`` / ``/stats``
+  surface as one replica, with budgeted retry-on-another-replica
+  failover and 503 + Retry-After when the fleet is empty; `run_router`
+  is the ``router`` task-type body (tasks/router.py,
+  `topologies.fleet_topology`).
+"""
+
+from tf_yarn_tpu.fleet.policy import (  # noqa: F401
+    POLICIES,
+    LeastLoadedPolicy,
+    RoundRobinPolicy,
+    make_policy,
+)
+from tf_yarn_tpu.fleet.registry import (  # noqa: F401
+    EJECTED,
+    HEALTHY,
+    PENDING,
+    STOPPED,
+    Replica,
+    ReplicaRegistry,
+    http_probe,
+)
+from tf_yarn_tpu.fleet.router import RouterServer, run_router  # noqa: F401
+
+__all__ = [
+    "EJECTED",
+    "HEALTHY",
+    "LeastLoadedPolicy",
+    "PENDING",
+    "POLICIES",
+    "Replica",
+    "ReplicaRegistry",
+    "RoundRobinPolicy",
+    "RouterServer",
+    "STOPPED",
+    "http_probe",
+    "make_policy",
+    "run_router",
+]

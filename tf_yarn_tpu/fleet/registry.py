@@ -1,0 +1,329 @@
+"""Replica registry: the router's live view of the serving fleet.
+
+Discovery and liveness ride the SAME coordination-KV protocol the rest
+of the runtime already speaks — no second control plane:
+
+* **discovery** — each serving replica advertises its HTTP endpoint as
+  ``{task}/serving_endpoint`` (event.serving_endpoint_event); the
+  registry watches those keys (an explicit task list from the cluster
+  spec, or a prefix scan when none is given).
+* **admission** — an advertised endpoint is NOT routable yet: the
+  replica stays ``pending`` until its first successful ``/healthz``
+  probe (a replica publishes its endpoint before the first tick has
+  compiled, and routing to it would burn the router's retry budget on
+  a cold socket). This closes the endpoint-published-before-healthy
+  discovery race.
+* **health ejection** — a replica is ejected from rotation when its
+  ``/healthz`` stops answering, answers anything but ``"ok"`` (the
+  preemption-drain ``"draining"`` state ejects BEFORE the socket goes
+  away), or its KV heartbeat goes beat-then-silent past
+  ``dead_heartbeat_s`` (the watchdog's posture, resilience/watchdog.py:
+  a wedged server can still accept TCP — the heartbeat is the signal
+  that the scheduler thread is alive). Ejected replicas are re-admitted
+  on the first healthy probe after recovery.
+* **finished is not dead** — a ``heartbeat.stopped`` tombstone or a
+  ``stop`` event removes the replica from rotation as ``stopped``
+  without counting an ejection, exactly like the watchdog.
+
+KV read errors degrade the view for one refresh (previous states hold);
+they never take the router down with the coordination link.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.resilience.taxonomy import classify_exception
+from tf_yarn_tpu.telemetry.heartbeat import heartbeat_age
+
+_logger = logging.getLogger(__name__)
+
+# Replica lifecycle states.
+PENDING = "pending"    # endpoint advertised, no healthy probe yet
+HEALTHY = "healthy"    # in rotation
+EJECTED = "ejected"    # out of rotation, re-admitted on recovery
+STOPPED = "stopped"    # tombstoned / stop event: finished, not dead
+
+DEFAULT_PROBE_TIMEOUT_S = 2.0
+DEFAULT_PROBE_INTERVAL_S = 1.0
+
+
+def http_probe(endpoint: str,
+               timeout: float = DEFAULT_PROBE_TIMEOUT_S) -> dict:
+    """GET ``/healthz`` on a replica; the parsed JSON on HTTP 200,
+    raises (ConnectionError family) otherwise. The default probe — tests
+    and the bench inject fakes through the ``probe=`` seam."""
+    host, _, port = endpoint.rpartition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        payload = resp.read()
+        if resp.status != 200:
+            raise ConnectionError(
+                f"/healthz on {endpoint} answered {resp.status}"
+            )
+        return json.loads(payload or b"{}")
+    finally:
+        conn.close()
+
+
+@dataclasses.dataclass
+class Replica:
+    """One serving replica as the registry sees it."""
+
+    task: str
+    endpoint: Optional[str] = None
+    state: str = PENDING
+    # Load signals from the last probe (the /healthz payload carries the
+    # scheduler occupancy) plus the router's own in-flight count — the
+    # between-polls correction that keeps least-loaded from dogpiling.
+    queue_depth: int = 0
+    active_slots: int = 0
+    inflight: int = 0
+    eject_reason: Optional[str] = None
+    last_probe_at: Optional[float] = None
+    ejections: int = 0
+    readmissions: int = 0
+    ever_beat: bool = False
+
+    @property
+    def load(self) -> int:
+        return self.queue_depth + self.active_slots + self.inflight
+
+    def snapshot(self) -> dict:
+        return {
+            "task": self.task,
+            "endpoint": self.endpoint,
+            "state": self.state,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "inflight": self.inflight,
+            "eject_reason": self.eject_reason,
+            "ejections": self.ejections,
+            "readmissions": self.readmissions,
+        }
+
+
+class ReplicaRegistry:
+    """Maintains the live replica set (module docstring).
+
+    ``tasks=None`` discovers replicas by scanning KV keys for
+    ``*/serving_endpoint``; a launcher passes the cluster's serving
+    tasks explicitly. ``dead_heartbeat_s=None`` disables the heartbeat
+    check (probes still govern health). ``probe_interval_s`` bounds
+    probe traffic per replica; ``refresh(force=True)`` probes
+    regardless (used right after an observed failure).
+    """
+
+    def __init__(
+        self,
+        kv,
+        tasks: Optional[Sequence[str]] = None,
+        *,
+        probe: Callable[[str], dict] = http_probe,
+        probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+        dead_heartbeat_s: Optional[float] = None,
+        clock=time.monotonic,
+        wall_clock=time.time,
+    ) -> None:
+        self._kv = kv
+        self._tasks = list(tasks) if tasks is not None else None
+        self._probe = probe
+        self.probe_interval_s = float(probe_interval_s)
+        self.dead_heartbeat_s = dead_heartbeat_s
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, Replica] = {}
+        self._registry = telemetry.get_registry()
+
+    # -- refresh (router poll loop; also on-demand from the router) --------
+
+    def refresh(self, force: bool = False) -> List[Replica]:
+        """One discovery + health pass; returns the healthy set."""
+        with self._lock:
+            for task in self._discover_tasks():
+                self._replicas.setdefault(task, Replica(task))
+            for replica in self._replicas.values():
+                self._refresh_one(replica, force)
+            healthy = self._healthy_locked()
+            self._registry.gauge("fleet/healthy_replicas").set(len(healthy))
+            return healthy
+
+    def _discover_tasks(self) -> List[str]:
+        from tf_yarn_tpu import event
+
+        if self._tasks is not None:
+            return self._tasks
+        suffix = f"/{event.SERVING_ENDPOINT}"
+        try:
+            keys = self._kv.keys("")
+        except Exception:
+            _logger.warning(
+                "registry KV key scan failed; keeping known replicas",
+                exc_info=True,
+            )
+            return list(self._replicas)
+        return sorted(
+            {key[: -len(suffix)] for key in keys if key.endswith(suffix)}
+        )
+
+    def _refresh_one(self, replica: Replica, force: bool) -> None:
+        from tf_yarn_tpu import event
+
+        try:
+            endpoint = self._kv.get_str(
+                f"{replica.task}/{event.SERVING_ENDPOINT}"
+            )
+            stopped = (
+                self._kv.get_str(
+                    f"{replica.task}/{event.HEARTBEAT_STOPPED}"
+                ) is not None
+                or self._kv.get_str(f"{replica.task}/{event.STOP}")
+                is not None
+            )
+            beat_raw = self._kv.get_str(f"{replica.task}/{event.HEARTBEAT}")
+        except Exception:
+            # A flaky KV read degrades the view for one refresh (the
+            # watchdog's posture) — previous states hold.
+            _logger.warning(
+                "registry KV read for %s failed; keeping previous state",
+                replica.task, exc_info=True,
+            )
+            return
+        if endpoint is None:
+            return  # not advertised yet: nothing to probe
+        replica.endpoint = endpoint
+        if stopped:
+            # Finished is not dead: out of rotation, no ejection counted.
+            replica.state = STOPPED
+            return
+        if beat_raw is not None:
+            replica.ever_beat = True
+            age = heartbeat_age(beat_raw, now=self._wall_clock())
+            if (
+                self.dead_heartbeat_s is not None
+                and age is not None
+                and age > self.dead_heartbeat_s
+            ):
+                # Beat-then-silent: the scheduler thread is gone even if
+                # the socket still answers — do not probe it back in.
+                if replica.state == HEALTHY:
+                    self._eject(replica, "heartbeat_silent")
+                return
+        now = self._clock()
+        if (
+            not force
+            and replica.last_probe_at is not None
+            and now - replica.last_probe_at < self.probe_interval_s
+        ):
+            return
+        replica.last_probe_at = now
+        try:
+            payload = self._probe(replica.endpoint)
+        except Exception as exc:
+            kind = classify_exception(exc)
+            _logger.info(
+                "probe of %s (%s) failed (%s: %s)", replica.task,
+                replica.endpoint, kind.value, exc,
+            )
+            if replica.state == HEALTHY:
+                self._eject(replica, "unreachable")
+            # PENDING stays pending: admission held until first health.
+            return
+        replica.queue_depth = int(payload.get("queue_depth") or 0)
+        replica.active_slots = int(payload.get("active_slots") or 0)
+        status = payload.get("status")
+        if status != "ok":
+            # "draining" lands here: ejected while the replica is still
+            # answering — the router stops sending BEFORE the socket dies.
+            if replica.state == HEALTHY:
+                self._eject(replica, str(status or "unhealthy"))
+            return
+        if replica.state == EJECTED:
+            replica.readmissions += 1
+            self._registry.counter("fleet/replica_readmissions_total").inc()
+            _logger.info(
+                "replica %s recovered (was ejected: %s); re-admitting",
+                replica.task, replica.eject_reason,
+            )
+        replica.state = HEALTHY
+        replica.eject_reason = None
+
+    def _eject(self, replica: Replica, reason: str) -> None:
+        replica.state = EJECTED
+        replica.eject_reason = reason
+        replica.ejections += 1
+        self._registry.counter(
+            "fleet/replica_ejections_total", reason=reason
+        ).inc()
+        _logger.warning(
+            "ejecting replica %s (%s): %s", replica.task, replica.endpoint,
+            reason,
+        )
+
+    # -- router-observed failures ------------------------------------------
+
+    def report_failure(self, task: str, exc: BaseException) -> None:
+        """A forward to `task` failed at the router: eject it NOW (the
+        next request must route elsewhere without waiting a probe
+        interval) and clear its probe clock so the next refresh probes
+        for recovery immediately."""
+        kind = classify_exception(exc)
+        with self._lock:
+            replica = self._replicas.get(task)
+            if replica is None:
+                return
+            if replica.state == HEALTHY:
+                self._eject(replica, f"request_{kind.value.lower()}")
+            replica.last_probe_at = None
+            self._registry.gauge("fleet/healthy_replicas").set(
+                len(self._healthy_locked())
+            )
+
+    def note_inflight(self, task: str, delta: int) -> None:
+        with self._lock:
+            replica = self._replicas.get(task)
+            if replica is not None:
+                replica.inflight = max(0, replica.inflight + delta)
+
+    # -- views --------------------------------------------------------------
+
+    def _healthy_locked(self) -> List[Replica]:
+        return sorted(
+            (r for r in self._replicas.values() if r.state == HEALTHY),
+            key=lambda r: r.task,
+        )
+
+    def healthy(self) -> List[Replica]:
+        with self._lock:
+            return self._healthy_locked()
+
+    def get(self, task: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(task)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            replicas = {
+                task: replica.snapshot()
+                for task, replica in sorted(self._replicas.items())
+            }
+            return {
+                "replicas": replicas,
+                "healthy_replicas": len(self._healthy_locked()),
+                "ejections_total": sum(
+                    r.ejections for r in self._replicas.values()
+                ),
+                "readmissions_total": sum(
+                    r.readmissions for r in self._replicas.values()
+                ),
+            }

@@ -1,0 +1,498 @@
+"""Router HTTP task: one endpoint in front of N serving replicas.
+
+The TF-Replicator argument applied to serving (PAPERS.md): the user
+keeps the single-machine-shaped API — the router exposes the IDENTICAL
+``/v1/generate`` / ``/healthz`` / ``/stats`` surface as one replica
+(serving/server.py) — while the framework owns replica discovery,
+placement, and failover behind it. The per-replica serving stack is
+untouched; only the replica axis scales.
+
+Same stdlib threaded-server shape as the replica frontend. Per request:
+
+1. pick a healthy replica via the configured policy (round-robin or
+   least-loaded over cached ``/healthz`` occupancy);
+2. forward. Connect errors and 429s fail over to ANOTHER replica,
+   budgeted through :class:`~tf_yarn_tpu.resilience.retry.RetryPolicy`
+   (per-kind budgets + decorrelated jitter; an upstream ``Retry-After``
+   is honored as the backoff floor when every replica has been tried);
+   a replica observed failing is ejected immediately
+   (``registry.report_failure``) so the next request routes elsewhere;
+3. streaming passthrough: upstream token lines are re-chunked to the
+   client as they arrive, so TTFT through the router is the replica's
+   plus one hop. A replica dying MID-stream cannot be retried (the 200
+   is on the wire) — the stream ends with a classified error line
+   (``{"error": ..., "failure_kind": ...}``) instead;
+4. no healthy replica (or budget exhausted): 503 with a ``Retry-After``
+   header — shed, don't buffer, the same backpressure posture as the
+   replica's 429.
+
+Deterministic 4xx from a replica (400 bad request, 404, 413) passes
+through verbatim — retrying a user error elsewhere just reproduces it,
+the FATAL_USER posture of the failure taxonomy.
+
+`run_router` is the ``router`` task body (tasks/router.py): build the
+registry over the cluster's serving tasks, refresh it on a poll loop,
+advertise ``{task}/router_endpoint``, serve until preemption/duration.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.fleet.policy import make_policy
+from tf_yarn_tpu.fleet.registry import Replica, ReplicaRegistry
+from tf_yarn_tpu.resilience.retry import RetryPolicy
+from tf_yarn_tpu.resilience.taxonomy import FailureKind, classify_exception
+
+_logger = logging.getLogger(__name__)
+
+# Cap on any single failover backoff sleep: a router request handler
+# must never hold its connection hostage to a long jitter tail.
+MAX_FAILOVER_SLEEP_S = 5.0
+
+# How long the router poll loop sleeps between registry refreshes; the
+# refresh itself rate-limits per-replica probes by probe_interval_s.
+POLL_S = 0.2
+
+
+class _UpstreamUnreachable(Exception):
+    """Connect/read failure BEFORE any byte reached the client: safe to
+    fail over to another replica."""
+
+    def __init__(self, replica: Replica, cause: BaseException):
+        super().__init__(f"replica {replica.task} unreachable: {cause}")
+        self.replica = replica
+        self.cause = cause
+
+
+class _UpstreamBusy(Exception):
+    """Upstream 429: that replica's admission queue is full; try
+    another, carrying the Retry-After hint."""
+
+    def __init__(self, replica: Replica, retry_after_s: float):
+        super().__init__(f"replica {replica.task} busy")
+        self.replica = replica
+        self.retry_after_s = retry_after_s
+
+
+class RouterServer:
+    """The fleet frontend over one ReplicaRegistry (module docstring)."""
+
+    def __init__(
+        self,
+        registry: ReplicaRegistry,
+        policy=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        retries: int = 2,
+        retry_after_s: float = 1.0,
+        upstream_timeout_s: float = 600.0,
+    ):
+        self.registry = registry
+        self.policy = policy if policy is not None else make_policy(
+            "least_loaded"
+        )
+        self.retries = int(retries)
+        self.retry_after_s = float(retry_after_s)
+        self.upstream_timeout_s = float(upstream_timeout_s)
+        self._metrics = telemetry.get_registry()
+        self._routed: Dict[str, Dict[str, int]] = {}
+        self._routed_lock = threading.Lock()
+        self._seq_lock = threading.Lock()
+        self._seq = 0
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"{host}:{self.port}"
+
+    def start(self) -> str:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router-http", daemon=True
+        )
+        self._thread.start()
+        _logger.info("router frontend listening on %s", self.endpoint)
+        return self.endpoint
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- accounting ---------------------------------------------------------
+
+    def _count(self, replica_task: str, outcome: str) -> None:
+        with self._routed_lock:
+            per = self._routed.setdefault(replica_task, {})
+            per[outcome] = per.get(outcome, 0) + 1
+        self._metrics.counter(
+            "fleet/routed_requests_total",
+            replica=replica_task, outcome=outcome,
+        ).inc()
+
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def stats(self) -> dict:
+        """Router snapshot for /stats and the task's flushed metrics."""
+        with self._routed_lock:
+            routed = {
+                task: dict(outcomes)
+                for task, outcomes in sorted(self._routed.items())
+            }
+        return {
+            "role": "router",
+            "policy": self.policy.name,
+            "retries": self.retries,
+            "routed_requests": routed,
+            **self.registry.snapshot(),
+        }
+
+
+def _make_handler(router: RouterServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            _logger.debug("router http %s", fmt % args)
+
+        # -- helpers (same wire shapes as serving/server.py) -------------
+
+        def _json(self, status: int, payload: dict, headers=()) -> None:
+            body = (json.dumps(payload) + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _raw(self, status: int, body: bytes,
+                 content_type: str = "application/json") -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _chunk_raw(self, data: bytes) -> None:
+            self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+            self.wfile.flush()
+
+        def _end_chunks(self) -> None:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        # -- routes ------------------------------------------------------
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                from tf_yarn_tpu import preemption
+
+                healthy = len(router.registry.healthy())
+                draining = preemption.requested()
+                self._json(200, {
+                    "status": "draining" if draining else "ok",
+                    "role": "router",
+                    "healthy_replicas": healthy,
+                })
+            elif self.path == "/stats":
+                self._json(200, router.stats())
+            else:
+                self._json(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._json(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                raw_body = self.rfile.read(length) or b"{}"
+                body = json.loads(raw_body)
+            except (TypeError, ValueError) as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            stream = bool(body.get("stream"))
+            try:
+                self._route(raw_body, stream)
+            except (BrokenPipeError, ConnectionResetError):
+                _logger.info("client dropped routed request")
+
+        # -- the routing loop --------------------------------------------
+
+        def _route(self, raw_body: bytes, stream: bool) -> None:
+            # Per-request failover budget: connect errors and 429s each
+            # consume from their kind's budget; deterministic jitter per
+            # request sequence number.
+            retry_policy = RetryPolicy.from_nb_retries(
+                router.retries, seed=router._next_seq()
+            )
+            tried: set = set()
+            busy_hint = 0.0
+            last_error = "no healthy replica"
+            while True:
+                replica = router.policy.pick(
+                    router.registry.healthy(), exclude=tried
+                )
+                if replica is None:
+                    if not tried:
+                        # Maybe the view is just stale (all ejected, or
+                        # never refreshed): one forced pass before 503.
+                        if router.registry.refresh(force=True):
+                            continue
+                        self._no_replica(busy_hint, last_error)
+                        return
+                    # Every healthy replica tried this pass: another
+                    # round costs one TRANSIENT retry, backing off with
+                    # jitter but never below the upstream Retry-After.
+                    delay = retry_policy.next_delay(FailureKind.TRANSIENT)
+                    if delay is None:
+                        self._no_replica(busy_hint, last_error)
+                        return
+                    time.sleep(
+                        min(max(delay, busy_hint), MAX_FAILOVER_SLEEP_S)
+                    )
+                    tried.clear()
+                    router.registry.refresh(force=True)
+                    continue
+                try:
+                    outcome = self._forward(replica, raw_body, stream)
+                except _UpstreamUnreachable as exc:
+                    router._count(replica.task, "connect_error")
+                    router.registry.report_failure(replica.task, exc.cause)
+                    tried.add(replica.task)
+                    last_error = str(exc)
+                    kind = classify_exception(exc.cause)
+                    if retry_policy.next_delay(kind) is None:
+                        self._no_replica(busy_hint, last_error)
+                        return
+                    continue  # fail over immediately: different replica
+                except _UpstreamBusy as exc:
+                    router._count(replica.task, "busy")
+                    tried.add(replica.task)
+                    busy_hint = max(busy_hint, exc.retry_after_s)
+                    last_error = (
+                        f"replica {replica.task} backpressured (429)"
+                    )
+                    if retry_policy.next_delay(
+                        FailureKind.TRANSIENT
+                    ) is None:
+                        self._no_replica(busy_hint, last_error)
+                        return
+                    continue
+                _logger.debug("routed request: %s", outcome)
+                return
+
+        def _no_replica(self, busy_hint: float, last_error: str) -> None:
+            # Counted BEFORE the response bytes go out: /stats read right
+            # after a reply must already include it.
+            router._count("-", "no_replica")
+            retry_after = max(router.retry_after_s, busy_hint)
+            self._json(
+                503,
+                {
+                    "error": (
+                        "no serving replica available: "
+                        f"{last_error}; retry in ~{retry_after:.1f}s"
+                    ),
+                    "retry_after_s": retry_after,
+                },
+                headers=(("Retry-After",
+                          str(max(1, int(retry_after)))),),
+            )
+
+        def _forward(self, replica: Replica, raw_body: bytes,
+                     stream: bool) -> str:
+            host, _, port = (replica.endpoint or "").rpartition(":")
+            conn = http.client.HTTPConnection(
+                host, int(port), timeout=router.upstream_timeout_s
+            )
+            router.registry.note_inflight(replica.task, +1)
+            try:
+                try:
+                    conn.request(
+                        "POST", "/v1/generate", raw_body,
+                        {"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                except (OSError, http.client.HTTPException) as exc:
+                    raise _UpstreamUnreachable(replica, exc) from exc
+                if resp.status == 429:
+                    try:
+                        retry_after = float(
+                            resp.getheader("Retry-After") or 1.0
+                        )
+                    except ValueError:
+                        retry_after = 1.0
+                    resp.read()
+                    raise _UpstreamBusy(replica, retry_after)
+                if not stream or resp.status != 200:
+                    try:
+                        payload = resp.read()
+                    except (OSError, http.client.HTTPException) as exc:
+                        # Died mid-body but nothing reached the client
+                        # yet: still safe to fail over.
+                        raise _UpstreamUnreachable(replica, exc) from exc
+                    outcome = (
+                        "ok" if resp.status == 200
+                        else f"upstream_{resp.status}"
+                    )
+                    router._count(replica.task, outcome)
+                    self._raw(
+                        resp.status, payload,
+                        resp.getheader("Content-Type")
+                        or "application/json",
+                    )
+                    return outcome
+                return self._forward_stream(replica, resp)
+            finally:
+                router.registry.note_inflight(replica.task, -1)
+                conn.close()
+
+        def _forward_stream(self, replica: Replica, resp) -> str:
+            """Chunked passthrough: each upstream token line re-chunks
+            to the client as it arrives (TTFT is the replica's plus one
+            hop). Mid-stream upstream death cannot fail over — the 200
+            is already on the wire — so the stream closes with a
+            classified error line and the replica is ejected."""
+            self.send_response(resp.status)
+            self.send_header(
+                "Content-Type",
+                resp.getheader("Content-Type") or "application/jsonl",
+            )
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                saw_done = False
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    self._chunk_raw(line)
+                    try:
+                        saw_done = bool(json.loads(line).get("done"))
+                    except ValueError:
+                        saw_done = False
+                if not saw_done:
+                    # Premature EOF reads as a CLEAN end through
+                    # http.client (readline's peek swallows
+                    # IncompleteRead) — the protocol's closing
+                    # {"done": true} line is the real termination
+                    # signal, so its absence IS the mid-stream death.
+                    raise ConnectionResetError(
+                        "stream ended without its done line"
+                    )
+                router._count(replica.task, "ok")
+                self._end_chunks()
+                return "ok"
+            except (OSError, http.client.HTTPException) as exc:
+                kind = classify_exception(exc)
+                _logger.warning(
+                    "replica %s failed mid-stream (%s): %s",
+                    replica.task, kind.value, exc,
+                )
+                router.registry.report_failure(replica.task, exc)
+                router._count(replica.task, "stream_error")
+                self._chunk_raw((json.dumps({
+                    "error": (
+                        f"replica {replica.task} failed mid-stream: {exc}"
+                    ),
+                    "failure_kind": kind.value,
+                    "done": True,
+                    "finish_reason": "error",
+                }) + "\n").encode())
+                self._end_chunks()
+                return "stream_error"
+
+    return Handler
+
+
+def run_router(experiment, runtime) -> dict:
+    """Task body for the ``router`` task type: registry over the
+    cluster's serving tasks → policy → frontend → advertise → refresh
+    loop. Returns the final router stats snapshot."""
+    from tf_yarn_tpu import event, preemption
+    from tf_yarn_tpu.resilience.watchdog import dead_task_secs_from_env
+    from tf_yarn_tpu.serving.server import advertised_endpoint
+
+    telemetry_task = getattr(
+        runtime, "task",
+        f"{runtime.task_key.type}:{runtime.task_key.id}",
+    )
+    telemetry.enable_env_jsonl(telemetry_task)
+    serving_tasks = [
+        instance.key.to_kv_str()
+        for instance in getattr(runtime, "cluster_tasks", [])
+        if instance.key.type == "serving"
+    ] or None  # None -> discover by KV scan
+    registry = ReplicaRegistry(
+        runtime.kv,
+        tasks=serving_tasks,
+        probe_interval_s=experiment.router_probe_interval_s,
+        dead_heartbeat_s=dead_task_secs_from_env(),
+    )
+    server = RouterServer(
+        registry,
+        make_policy(experiment.router_policy),
+        experiment.router_host,
+        experiment.router_port,
+        retries=experiment.router_retries,
+        retry_after_s=experiment.retry_after_s,
+    )
+    endpoint = server.start()
+    advertised = advertised_endpoint(experiment.router_host, server.port)
+    event.router_endpoint_event(runtime.kv, runtime.task, advertised)
+    _logger.info(
+        "router on %s (advertised %s): policy=%s over %s",
+        endpoint, advertised, experiment.router_policy,
+        serving_tasks or "KV-discovered replicas",
+    )
+    deadline = (
+        time.monotonic() + experiment.serve_seconds
+        if experiment.serve_seconds is not None else None
+    )
+    try:
+        while True:
+            if preemption.requested():
+                _logger.info("router draining on preemption notice")
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                _logger.info(
+                    "serve_seconds=%.1f elapsed; router shutting down",
+                    experiment.serve_seconds,
+                )
+                break
+            registry.refresh()
+            time.sleep(POLL_S)
+    finally:
+        server.stop()
+        stats = {"endpoint": advertised, **server.stats()}
+        _logger.info("router done: %s", stats)
+        telemetry.flush_metrics(
+            telemetry.get_registry(),
+            kv=getattr(runtime, "kv", None),
+            task=telemetry_task,
+        )
+        telemetry.export_trace(telemetry_task)
+    return stats

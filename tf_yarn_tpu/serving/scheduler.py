@@ -151,6 +151,7 @@ class SlotScheduler:
         self._used_before = [False] * max_slots
         self.trace: Deque[Dict] = collections.deque(maxlen=trace_len)
         self._ticks = 0
+        self._draining = False
         self._work = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -582,9 +583,24 @@ class SlotScheduler:
             if self._slots[slot] is not None:
                 self._retire(slot, reason, retired)
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Mark this grid as draining (preemption notice, planned
+        shutdown): surfaced in `stats()` and the frontend's `/healthz`
+        so load balancers — the fleet router's registry in particular —
+        eject the replica from rotation BEFORE it stops accepting.
+        Scheduling itself continues until `close()`."""
+        if not self._draining:
+            self._draining = True
+            _logger.info("scheduler marked draining")
+
     def close(self) -> None:
         """Stop the loop; fail queued and in-flight requests as
         `shutdown` so no client blocks forever on a dead grid."""
+        self._draining = True
         self._stop.set()
         self._work.set()
         if self._thread is not None:
@@ -608,6 +624,7 @@ class SlotScheduler:
             "top_p": self.top_p,
             "kv_layout": self.kv_layout,
             "kv_cache_hbm_bytes": self._kv_bytes,
+            "draining": self._draining,
         }
         if self.kv_layout == "paged":
             snap["block_size"] = self._block_size

@@ -107,9 +107,22 @@ def _make_handler(scheduler: SlotScheduler):
 
         def do_GET(self):
             if self.path == "/healthz":
+                from tf_yarn_tpu import preemption
+
                 snap = scheduler.stats()
+                # Regression (see tests): this used to report "ok" even
+                # after the preemption-drain notice fired — the window
+                # where a load balancer keeps sending to a replica that
+                # is about to vanish. Consulting the signal flag
+                # directly (not just the scheduler flag run_serving
+                # sets on its next poll) closes the race to the instant
+                # the notice lands; the fleet router's registry ejects
+                # "draining" replicas before they stop accepting.
+                draining = bool(
+                    snap.get("draining")
+                ) or preemption.requested()
                 self._json(200, {
-                    "status": "ok",
+                    "status": "draining" if draining else "ok",
                     "active_slots": snap["active_slots"],
                     "queue_depth": snap["queue_depth"],
                 })
@@ -295,6 +308,7 @@ def run_serving(experiment, runtime=None) -> dict:
         while True:
             if preemption.requested():
                 _logger.info("serving task draining on preemption notice")
+                scheduler.drain()  # surfaced in /healthz + /stats
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 _logger.info(

@@ -1,0 +1,68 @@
+"""Task program for the ``router`` task type.
+
+The fleet-frontend sibling of tasks/serving.py: bootstrap, pull the
+ServingExperiment from the KV store (the router reads its ``router_*``
+knobs from the same experiment the replicas serve), and run the fleet
+router (`tf_yarn_tpu.fleet.router.run_router`) under the same lifecycle
+events, heartbeats, and failure classification — a crashed router is
+classified through its stop event and relaunched by the driver's
+RetryPolicy, and the heartbeat watchdog turns a wedged-but-alive router
+into a LOST_TASK within one poll.
+
+SIGTERM (the TPU-VM preemption notice) flips the drain flag the router
+loop polls AND its ``/healthz`` to ``draining``, so an upstream load
+balancer stops sending before the socket goes away — the same
+drain-visibility contract the replicas honor.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event, telemetry
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def _run(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    from tf_yarn_tpu import experiment as experiment_mod
+    from tf_yarn_tpu.fleet.router import run_router
+
+    if not isinstance(experiment, experiment_mod.ServingExperiment):
+        raise TypeError(
+            f"router tasks expect a ServingExperiment, got "
+            f"{type(experiment)!r}"
+        )
+    run_router(experiment, runtime=runtime)
+
+
+def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    preemption.install()
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        # MonitoredThread so the captured exception carries the router
+        # stack into the stop event (classification reads it there).
+        thread = MonitoredThread(
+            target=_run,
+            args=(runtime, experiment),
+            name=f"route-{runtime.task}",
+        )
+        with telemetry.Heartbeat(
+            runtime.kv, runtime.task,
+            every=telemetry.heartbeat.every_from_env(),
+            registry=telemetry.get_registry(),
+        ):
+            thread.start()
+            thread.join()
+        if thread.exception is not None:
+            raise thread.exception
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,9 @@ MAX_HOST_MEMORY_GIB = 448
 MAX_HOST_VCORES = 224
 MAX_CHIPS_PER_HOST = 8
 
-ALL_TASK_TYPES = {"chief", "worker", "evaluator", "tensorboard", "serving"}
+ALL_TASK_TYPES = {
+    "chief", "worker", "evaluator", "tensorboard", "serving", "router",
+}
 
 # Known slice shapes: name -> (total chips, hosts). Used by
 # `tpu_slice_topology` to expand a slice type into a host/chip layout.
@@ -163,6 +165,21 @@ def _check_general_topology(task_specs: TaskSpecs) -> None:
             raise ValueError(f"at most one {task_type} is allowed")
         if task_type in task_specs and task_specs[task_type].label is NodeLabel.TPU:
             raise ValueError(f"{task_type} is a CPU side-car; it cannot reserve chips")
+    if "router" in task_specs:
+        router = task_specs["router"]
+        if router.label is NodeLabel.TPU:
+            raise ValueError(
+                "router is a CPU frontend; it cannot reserve chips"
+            )
+        n_serving = (
+            task_specs["serving"].instances if "serving" in task_specs else 0
+        )
+        if router.instances > 0 and n_serving < 1:
+            raise ValueError(
+                "a router task needs at least one serving replica to route "
+                "to — add a 'serving' spec with instances >= 1 "
+                "(topologies.fleet_topology builds the pair)"
+            )
 
 
 def check_topology(task_specs: TaskSpecs) -> None:
@@ -255,6 +272,38 @@ def serving_topology(
             label=NodeLabel.TPU if chips_per_host else NodeLabel.CPU,
         )
     }
+    check_topology(specs)
+    return specs
+
+
+def fleet_topology(
+    nb_replicas: int = 2,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    chips_per_host: int = 1,
+    router_memory_gib: int = 8,
+    router_vcores: int = 4,
+) -> TaskSpecs:
+    """A serving fleet: one CPU ``router`` frontend load-balancing
+    ``/v1/generate`` across `nb_replicas` independent serving replicas
+    (tf_yarn_tpu/fleet/, docs/Fleet.md). The replicas are exactly
+    `serving_topology`'s — each restores the checkpoint and serves its
+    own slot grid — and the router discovers them through their KV
+    ``serving_endpoint`` advertisements, ejecting unhealthy or draining
+    replicas from rotation. Clients dial the router's single advertised
+    endpoint (``{task}/router_endpoint``)."""
+    specs = serving_topology(
+        instances=nb_replicas,
+        memory_gib=memory_gib,
+        vcores=vcores,
+        chips_per_host=chips_per_host,
+    )
+    specs["router"] = TaskSpec(
+        memory_gib=router_memory_gib,
+        vcores=router_vcores,
+        instances=1,
+        label=NodeLabel.CPU,
+    )
     check_topology(specs)
     return specs
 

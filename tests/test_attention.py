@@ -257,13 +257,15 @@ def test_flash_attention_partitions_batch_under_pjit():
 
     out = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(
         qs, ks, vs)
-    assert out.sharding.spec == P("dp"), out.sharding
+    # Spec normalization differs across jax builds (P("dp") vs
+    # P("dp", None, ...)): assert the batch dim is the sharded one.
+    assert out.sharding.spec[0] == "dp", out.sharding
     ref = attention(q, k, v, impl="xla", causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
 
     grad = jax.jit(jax.grad(
         lambda q: flash_attention(q, ks, vs, causal=True).sum()))(qs)
-    assert grad.sharding.spec == P("dp"), grad.sharding
+    assert grad.sharding.spec[0] == "dp", grad.sharding
     gref = jax.grad(
         lambda q: attention(q, k, v, impl="xla", causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(grad), np.asarray(gref), atol=2e-2)

@@ -1,0 +1,414 @@
+"""Elastic training: resize-not-retry on capacity loss.
+
+The acceptance case actually kills a host: ``lose_host_at_step=N``
+SIGKILLs worker:1 of a REAL two-process jax.distributed run mid-training
+— no stop event, no drain — and the driver must classify LOST_TASK,
+shrink the relaunch to the surviving host, refit the declared dp=2 mesh
+onto the single device, reshard the restored checkpoint onto it, rescale
+the survivor's input share to the full (unchanged) global batch, and
+finish the run. The pre-crash checkpoints are bit-identical to an
+uninterrupted run's (same topology, same data); the post-shrink steps
+match it to float-addition-order noise (~1 ulp — the reduction grouping
+over 1 device differs from 2, see docs/Resilience.md for why cross-size
+resume is exact-to-placement but not bitwise)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tf_yarn_tpu import checkpoint as ckpt_lib
+from tf_yarn_tpu.parallel.mesh import MeshSpec, resize_mesh_spec
+from tf_yarn_tpu.resilience import (
+    ElasticPolicy,
+    ElasticResize,
+    FailureKind,
+    RetryPolicy,
+    chaos,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# --- ElasticPolicy decisions ----------------------------------------------
+
+
+def test_policy_validates_band():
+    with pytest.raises(ValueError):
+        ElasticPolicy(min_workers=0, max_workers=2)
+    with pytest.raises(ValueError):
+        ElasticPolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError):
+        ElasticPolicy(min_workers=1, max_workers=2, shrink_step=0)
+
+
+def test_policy_shrinks_on_capacity_kinds_only():
+    policy = ElasticPolicy(min_workers=1, max_workers=4)
+    assert policy.plan_resize(FailureKind.LOST_TASK, 4) == 3
+    assert policy.plan_resize(FailureKind.PREEMPTED, 3, lost_tasks=2) == 1
+    # At the floor: no further shrink (relaunch waits, as without elastic).
+    assert policy.plan_resize(FailureKind.LOST_TASK, 1) is None
+    assert policy.history == [
+        ElasticResize("shrink", 4, 3, FailureKind.LOST_TASK),
+        ElasticResize("shrink", 3, 1, FailureKind.PREEMPTED),
+    ]
+
+
+def test_policy_observed_losses_beat_shrink_step():
+    policy = ElasticPolicy(min_workers=1, max_workers=8, shrink_step=2)
+    # shrink_step is the floor; the observed lost-host count wins above it.
+    assert policy.plan_resize(FailureKind.LOST_TASK, 8, lost_tasks=1) == 6
+    assert policy.plan_resize(FailureKind.LOST_TASK, 6, lost_tasks=5) == 1
+
+
+def test_policy_grows_back_on_non_capacity_relaunch():
+    policy = ElasticPolicy(min_workers=1, max_workers=4)
+    assert policy.plan_resize(FailureKind.LOST_TASK, 4) == 3
+    # A TRANSIENT relaunch while degraded re-requests full capacity...
+    assert policy.plan_resize(FailureKind.TRANSIENT, 3) == 4
+    # ...but at full size there is nothing to grow.
+    assert policy.plan_resize(FailureKind.TRANSIENT, 4) is None
+    assert [r.direction for r in policy.history] == ["shrink", "grow"]
+    assert policy.degraded(3) and not policy.degraded(4)
+
+
+def test_policy_regrow_false_pins_degraded_size():
+    policy = ElasticPolicy(min_workers=1, max_workers=4, regrow=False)
+    assert policy.plan_resize(FailureKind.PREEMPTED, 4) == 3
+    assert policy.plan_resize(FailureKind.TRANSIENT, 3) is None
+
+
+# --- mesh refit ------------------------------------------------------------
+
+
+def test_resize_mesh_spec_rescales_data_axes():
+    assert resize_mesh_spec(MeshSpec(dp=8), 4) == MeshSpec(dp=4)
+    assert resize_mesh_spec(MeshSpec(fsdp=8), 4) == MeshSpec(fsdp=4)
+    # fsdp keeps as much sharding as still divides; dp absorbs the rest.
+    assert resize_mesh_spec(MeshSpec(dp=2, fsdp=4), 4) == MeshSpec(dp=1, fsdp=4)
+    assert resize_mesh_spec(MeshSpec(dp=2, fsdp=4), 2) == MeshSpec(dp=1, fsdp=2)
+    # Growing back is the same refit in the other direction.
+    assert resize_mesh_spec(MeshSpec(dp=1, fsdp=2), 8) == MeshSpec(dp=4, fsdp=2)
+
+
+def test_resize_mesh_spec_preserves_model_axes():
+    spec = MeshSpec(dp=4, tp=2)
+    assert resize_mesh_spec(spec, 4) == MeshSpec(dp=2, tp=2)
+    # A device count that cannot host tp=2 is not elastically absorbable.
+    with pytest.raises(ValueError, match="model axes"):
+        resize_mesh_spec(spec, 3)
+    with pytest.raises(ValueError, match="devices"):
+        resize_mesh_spec(spec, 0)
+
+
+# --- host-share input opt-in ----------------------------------------------
+
+
+def test_input_iter_passes_host_slot(monkeypatch):
+    from tf_yarn_tpu import training
+
+    seen = {}
+
+    def input_fn(start_step=0, host_index=None, num_hosts=None):
+        seen.update(
+            start_step=start_step, host_index=host_index, num_hosts=num_hosts
+        )
+        return iter([{"x": np.zeros((2, 2))}])
+
+    it = training._make_input_iter(input_fn, 6, training._logger)
+    next(it)
+    import jax
+
+    assert seen == {
+        "start_step": 6,
+        "host_index": jax.process_index(),
+        "num_hosts": jax.process_count(),
+    }
+
+    # Plain input_fns keep working untouched.
+    it = training._make_input_iter(
+        lambda: iter([{"x": np.zeros((2, 2))}]), 0, training._logger
+    )
+    next(it)
+
+
+# --- train-loop mesh refit ---------------------------------------------------
+
+
+def test_train_loop_refits_declared_mesh_under_elastic_env(
+    tmp_path, monkeypatch
+):
+    """An elastic relaunch (driver env set, fewer devices than the
+    experiment's declared mesh) refits the data axes in-process, resumes,
+    and reports the mesh_devices/degraded gauges through the registry."""
+    import optax
+
+    from tf_yarn_tpu import constants, telemetry
+    from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+    from tf_yarn_tpu.experiment import as_core_experiment
+    from tf_yarn_tpu.models import common, mnist
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.training import train_and_evaluate
+
+    def make_exp():
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=lambda: common.synthetic_classification_iter(
+                8, 16, 4
+            ),
+            train_params=TrainParams(train_steps=4, log_every_steps=2),
+            mesh_spec=MeshSpec(dp=8),
+            model_dir=str(tmp_path / "model"),
+        )
+
+    # Full-capacity leg: declared mesh fits the 8 devices exactly.
+    train_and_evaluate(
+        as_core_experiment(make_exp()),
+        devices=select_devices(8, platform="cpu"),
+    )
+    snap = telemetry.get_registry().snapshot()
+    assert snap["train/mesh_devices"] == 8.0
+    assert snap["train/degraded"] == 0.0
+
+    # Degraded relaunch: same declared dp=8 mesh, but the driver says the
+    # attempt owns half the workers and hands over 4 devices — the loop
+    # refits to dp=4, reshards the restored state, and flags degraded.
+    monkeypatch.setenv(constants.ENV_ELASTIC_WORKERS, "1")
+    monkeypatch.setenv(constants.ENV_ELASTIC_MAX_WORKERS, "2")
+    exp = make_exp()
+    exp.train_params = TrainParams(train_steps=8, log_every_steps=2)
+    metrics = train_and_evaluate(
+        as_core_experiment(exp), devices=select_devices(4, platform="cpu")
+    )
+    assert np.isfinite(metrics["loss"])
+    snap = telemetry.get_registry().snapshot()
+    assert snap["train/mesh_devices"] == 4.0
+    assert snap["train/degraded"] == 1.0
+    assert ckpt_lib.latest_verified_step(str(tmp_path / "model")) == 8
+
+    # WITHOUT the elastic env the mismatch still fails loudly — a silently
+    # smaller mesh would hide a broken reservation.
+    monkeypatch.delenv(constants.ENV_ELASTIC_WORKERS)
+    monkeypatch.delenv(constants.ENV_ELASTIC_MAX_WORKERS)
+    with pytest.raises(ValueError, match="devices"):
+        train_and_evaluate(
+            as_core_experiment(make_exp()),
+            devices=select_devices(4, platform="cpu"),
+        )
+
+
+# --- driver validation ------------------------------------------------------
+
+
+def test_run_on_tpu_validates_elastic_topology():
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    with pytest.raises(ValueError, match="worker"):
+        run_on_tpu(
+            lambda: None,
+            {"chief": TaskSpec(instances=1)},
+            elastic_policy=ElasticPolicy(min_workers=1, max_workers=2),
+        )
+    with pytest.raises(ValueError, match="elastic band"):
+        run_on_tpu(
+            lambda: None,
+            {"worker": TaskSpec(instances=4)},
+            elastic_policy=ElasticPolicy(min_workers=1, max_workers=2),
+        )
+
+
+# --- end-to-end: lose a host, shrink, resume, finish ------------------------
+
+
+def _elastic_experiment_fn(model_dir, marker_path, train_steps=10):
+    """Deterministic mnist run over a dp=2 mesh whose input_fn yields this
+    host's CONTIGUOUS share of a FIXED 16-row global batch (pure function
+    of the step), so any host count replays the identical global stream.
+    Each attempt appends "n_try:num_hosts:start_step" to `marker_path`
+    from host 0 — the test's evidence of what the relaunch actually ran."""
+
+    def experiment_fn():
+        import numpy as np
+        import optax
+
+        from tf_yarn_tpu.experiment import JaxExperiment, TrainParams
+        from tf_yarn_tpu.models import common, mnist
+        from tf_yarn_tpu.parallel.mesh import MeshSpec
+
+        def input_fn(start_step=0, host_index=0, num_hosts=1):
+            import os
+
+            if host_index == 0:
+                with open(marker_path, "a") as fh:
+                    fh.write(
+                        f"{os.environ.get('TPU_YARN_N_TRY')}:"
+                        f"{num_hosts}:{start_step}\n"
+                    )
+
+            def gen():
+                step = start_step
+                per = 16 // num_hosts
+                lo = host_index * per
+                while True:
+                    step += 1
+                    rng = np.random.RandomState(10_000 + step)
+                    x = rng.normal(size=(16, 8)).astype(np.float32)
+                    y = rng.randint(0, 4, size=(16,)).astype(np.int32)
+                    yield {"x": x[lo:lo + per], "y": y[lo:lo + per]}
+
+            return gen()
+
+        return JaxExperiment(
+            model=mnist.DenseClassifier(hidden_sizes=(16,), num_classes=4),
+            optimizer=optax.adam(1e-2),
+            loss_fn=common.classification_loss,
+            train_input_fn=input_fn,
+            train_params=TrainParams(
+                train_steps=train_steps, log_every_steps=2,
+                checkpoint_every_steps=2, keep_last_n=None, seed=0,
+            ),
+            mesh_spec=MeshSpec(dp=2),
+            model_dir=model_dir,
+        )
+
+    return experiment_fn
+
+
+def _host_state(model_dir, step):
+    import jax
+
+    return jax.tree_util.tree_leaves(
+        ckpt_lib.restore_checkpoint_host(model_dir, step)
+    )
+
+
+def test_lose_host_elastic_shrink_resumes_and_matches(tmp_path):
+    """THE acceptance case (ISSUE 8): worker:1 of a 2-process run is
+    SIGKILLed at step 5; the driver classifies LOST_TASK, shrinks to the
+    surviving host, and the resumed run finishes all 10 steps with the
+    global batch and data order unchanged. Pre-crash checkpoints are
+    bit-identical to the uninterrupted run's; the final state matches it
+    to reduction-order noise."""
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    base_env = {
+        "TPU_YARN_PLATFORM": "cpu",
+        "TPU_YARN_HEARTBEAT_SECS": "0.5",
+    }
+    steps = 10
+
+    clean_dir = str(tmp_path / "clean")
+    run_on_tpu(
+        _elastic_experiment_fn(clean_dir, str(tmp_path / "clean-marker"),
+                               steps),
+        {"worker": TaskSpec(instances=2)},
+        env=dict(base_env),
+        poll_every_secs=0.2,
+    )
+
+    chaos_dir = str(tmp_path / "chaos")
+    marker = str(tmp_path / "chaos-marker")
+    retry = RetryPolicy.from_nb_retries(
+        2, seed=7, base_backoff_secs=0.2, max_backoff_secs=1.0
+    )
+    elastic = ElasticPolicy(min_workers=1, max_workers=2)
+    metrics = run_on_tpu(
+        _elastic_experiment_fn(chaos_dir, marker, steps),
+        {"worker": TaskSpec(instances=2)},
+        env=dict(base_env, TPU_YARN_FAULT="lose_host_at_step=5@worker:1"),
+        retry_policy=retry,
+        elastic_policy=elastic,
+        dead_task_secs=3.0,
+        poll_every_secs=0.2,
+    )
+    assert metrics is not None
+
+    # The driver classified the silent death LOST_TASK and shrank 2 -> 1.
+    assert [d.kind for d in retry.history] == [FailureKind.LOST_TASK]
+    assert elastic.history == [
+        ElasticResize("shrink", 2, 1, FailureKind.LOST_TASK)
+    ]
+
+    # The relaunch really ran on ONE host and resumed from a pre-crash
+    # checkpoint (step 2 or 4 — whichever save had committed its manifest
+    # before the SIGKILL landed).
+    attempts = [line.split(":") for line in
+                open(marker).read().strip().splitlines()]
+    assert [a[0] for a in attempts] == ["0", "1"]
+    assert attempts[0][1] == "2"  # attempt 0: two hosts
+    assert attempts[1][1] == "1"  # relaunch: the survivor alone
+    resume_step = int(attempts[1][2])
+    assert resume_step in (2, 4)
+
+    # Pre-crash determinism: the checkpoint the relaunch resumed FROM is
+    # bit-identical to the uninterrupted run's same-step checkpoint — the
+    # resharded resume started from exactly the clean state.
+    for a, b in zip(_host_state(clean_dir, resume_step),
+                    _host_state(chaos_dir, resume_step)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Post-shrink the math is identical up to float reduction grouping
+    # (1 device sums the batch in one chain, 2 devices in two + psum):
+    # ~1 ulp per step, far below any training-visible scale.
+    assert ckpt_lib.latest_verified_step(chaos_dir) == steps
+    clean_final = _host_state(clean_dir, steps)
+    chaos_final = _host_state(chaos_dir, steps)
+    assert len(clean_final) == len(chaos_final)
+    for a, b in zip(clean_final, chaos_final):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float64), np.asarray(b, np.float64),
+            rtol=0, atol=1e-5,
+        )
+
+
+@pytest.mark.slow  # a third multi-process launch cycle; tier-1 keeps the
+# elastic acceptance e2e above and test_resilience's same-size
+# bit-for-bit recovery — this covers their intersection.
+def test_lose_host_without_elastic_policy_relaunches_full(tmp_path):
+    """Without an ElasticPolicy the behavior is unchanged: the relaunch
+    re-requests the SAME topology (both workers) and — capacity being
+    available here — finishes bit-for-bit with the uninterrupted run."""
+    from tf_yarn_tpu.client import run_on_tpu
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    base_env = {
+        "TPU_YARN_PLATFORM": "cpu",
+        "TPU_YARN_HEARTBEAT_SECS": "0.5",
+    }
+    steps = 8
+    clean_dir = str(tmp_path / "clean")
+    run_on_tpu(
+        _elastic_experiment_fn(clean_dir, str(tmp_path / "m0"), steps),
+        {"worker": TaskSpec(instances=2)},
+        env=dict(base_env),
+        poll_every_secs=0.2,
+    )
+    chaos_dir = str(tmp_path / "chaos")
+    marker = str(tmp_path / "m1")
+    retry = RetryPolicy.from_nb_retries(
+        2, seed=3, base_backoff_secs=0.2, max_backoff_secs=1.0
+    )
+    run_on_tpu(
+        _elastic_experiment_fn(chaos_dir, marker, steps),
+        {"worker": TaskSpec(instances=2)},
+        env=dict(base_env, TPU_YARN_FAULT="lose_host_at_step=3@worker:1"),
+        retry_policy=retry,
+        dead_task_secs=3.0,
+        poll_every_secs=0.2,
+    )
+    assert [d.kind for d in retry.history] == [FailureKind.LOST_TASK]
+    attempts = [line.split(":") for line in
+                open(marker).read().strip().splitlines()]
+    assert [a[1] for a in attempts] == ["2", "2"]  # same topology twice
+    for a, b in zip(_host_state(clean_dir, steps),
+                    _host_state(chaos_dir, steps)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

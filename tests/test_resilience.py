@@ -249,6 +249,54 @@ def test_chaos_reads_env_lazily(monkeypatch):
     assert not chaos.active()
 
 
+def test_parse_lose_host_clause():
+    plan = parse_fault_spec("lose_host_at_step=5")
+    assert plan.lose_host_at_step == 5
+    assert plan.lose_host_task is None
+    plan = parse_fault_spec("lose_host_at_step=7@worker:1")
+    assert plan.lose_host_at_step == 7
+    assert plan.lose_host_task == "worker:1"
+    assert plan.any()
+    with pytest.raises(ValueError):
+        parse_fault_spec("lose_host_at_step=x@worker:1")
+
+
+def test_lose_host_respects_task_filter_and_arming(monkeypatch):
+    """The clause must not fire in a task it doesn't name (or the whole
+    fleet would die, not one host) nor on a retried attempt. The actual
+    SIGKILL is exercised end-to-end in tests/test_elastic.py — here the
+    filter paths prove a no-op without killing the test process."""
+    monkeypatch.setenv("TPU_YARN_TASK", "worker:0")
+    chaos.configure("lose_host_at_step=3@worker:1", n_try=0)
+    chaos.on_train_step(3)  # wrong task: survives
+    chaos.configure("lose_host_at_step=3@worker:1", n_try=1)
+    assert not chaos.active()  # retried attempt: disarmed
+    monkeypatch.setenv("TPU_YARN_TASK", "worker:1")
+    chaos.configure("lose_host_at_step=3@worker:1", n_try=0)
+    chaos.on_train_step(2)  # wrong step: survives
+
+
+def test_silent_killed_primary_classifies_attempt_as_lost_task():
+    """A host that dies without a stop event (the lose_host signature)
+    dominates collateral TRANSIENT failures from surviving workers — the
+    attempt classifies LOST_TASK, the elastic resize trigger."""
+    from tf_yarn_tpu.client import _attempt_kind, _lost_primaries
+    from tf_yarn_tpu.utils.metrics import TaskOutcome
+
+    outcomes = {
+        "worker:0": TaskOutcome("FAILED", "ConnectionError: peer gone",
+                                FailureKind.TRANSIENT),
+        "worker:1": TaskOutcome("KILLED", ""),  # SIGKILL: no stop event
+        "evaluator:0": TaskOutcome("KILLED", ""),  # side-car: not primary
+    }
+    failures = {"worker:0": outcomes["worker:0"]}
+    assert _attempt_kind(outcomes, failures, []) is FailureKind.LOST_TASK
+    assert _lost_primaries(outcomes, []) == ["worker:1"]
+    # The watchdog's precise set wins when it fired (the driver's kill
+    # leaves every wedged survivor equally stop-event-less).
+    assert _lost_primaries(outcomes, ["worker:1"]) == ["worker:1"]
+
+
 def test_chaos_kv_delay_is_seeded_and_probabilistic():
     chaos.configure("kv_delay=1.0,0.05", seed=3)
     t0 = time.perf_counter()

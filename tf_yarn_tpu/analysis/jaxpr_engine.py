@@ -82,6 +82,15 @@ def capabilities() -> frozenset:
     caps = set()
     if hasattr(jax, "shard_map"):
         caps.add("jax.shard_map")
+    else:
+        try:
+            # Older builds: parallel.collectives.shard_map falls back to
+            # the experimental module, so the capability is still real.
+            from jax.experimental.shard_map import shard_map  # noqa: F401
+
+            caps.add("jax.shard_map")
+        except ImportError:
+            pass
     try:
         from jax.experimental.custom_partitioning import (
             custom_partitioning,
@@ -283,15 +292,15 @@ def _ops_entries() -> List[EntryPoint]:
 
         return roundtrip, (_f32(8, 128),), {}
 
-    # The fused norms partition via Shardy sharding rules
-    # (make_sharded_op); a jax build without them cannot even trace the
-    # custom_partitioning registration.
-    shardy = ("custom_partitioning.sharding_rule",)
+    # The fused norms partition via Shardy sharding rules where the build
+    # has them, and via the infer_sharding_from_operands fallback
+    # elsewhere (make_sharded_op) — the registration traces on both, so
+    # these entries are no longer capability-gated.
     return [
         EntryPoint("ops.attention.xla_attention", attention_xla),
-        EntryPoint("ops.rmsnorm.rmsnorm", rmsnorm, requires=shardy),
-        EntryPoint("ops.rmsnorm.rmsnorm_grad", rmsnorm_grad, requires=shardy),
-        EntryPoint("ops.layernorm.layernorm", layernorm, requires=shardy),
+        EntryPoint("ops.rmsnorm.rmsnorm", rmsnorm),
+        EntryPoint("ops.rmsnorm.rmsnorm_grad", rmsnorm_grad),
+        EntryPoint("ops.layernorm.layernorm", layernorm),
         EntryPoint("ops.quantize.int8_roundtrip", quantize),
     ]
 

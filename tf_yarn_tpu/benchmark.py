@@ -83,20 +83,24 @@ def measure_throughput(
                 return model.init(rng, next(iter(features.values())))
             return model.init(rng, **features)
 
+    def init_state(rng, batch):
+        variables = init_fn(rng, batch)
+        params = unbox_params(variables)
+        return TrainState(np.int32(0), params, optimizer.init(params))
+
+    def init_boxed(rng, batch):
+        variables = init_fn(rng, batch)
+        return TrainState(np.int32(0), variables, optimizer.init(variables))
+
+    placed = {k: jax.device_put(np.asarray(v)) for k, v in batch.items()}
+    abstract = jax.eval_shape(init_boxed, rng, placed)
+    shardings = tree_shardings(mesh, abstract)
+    # Init before entering the ambient mesh: flax's in-init unbox would
+    # otherwise constrain with raw logical axis names (see
+    # sharding.unbox_params); out_shardings are explicit NamedShardings.
+    state = jax.jit(init_state, out_shardings=shardings)(rng, placed)
+
     with mesh:
-        def init_state(rng, batch):
-            variables = init_fn(rng, batch)
-            params = unbox_params(variables)
-            return TrainState(np.int32(0), params, optimizer.init(params))
-
-        def init_boxed(rng, batch):
-            variables = init_fn(rng, batch)
-            return TrainState(np.int32(0), variables, optimizer.init(variables))
-
-        placed = {k: jax.device_put(np.asarray(v)) for k, v in batch.items()}
-        abstract = jax.eval_shape(init_boxed, rng, placed)
-        shardings = tree_shardings(mesh, abstract)
-        state = jax.jit(init_state, out_shardings=shardings)(rng, placed)
         step_core = build_train_step(model, loss_fn, optimizer)
 
         # The measured loop runs *inside* one jitted program (lax.scan over

@@ -90,6 +90,22 @@ def checkpoint_path(model_dir: str, step: int) -> str:
     return fs_lib.join(model_dir, f"ckpt-{step}")
 
 
+def _canonicalize_for_save(state: Any) -> Any:
+    """Orbax's StandardCheckpointHandler accepts int / float / np.ndarray /
+    jax.Array leaves; bare numpy *scalars* (``np.int32(3)`` — e.g. a
+    host-side step counter in a TrainState) are rejected by newer orbax.
+    Promote them to 0-d ndarrays: dtype preserved, restores as a 0-d
+    array every consumer here treats identically. Applied on every save
+    entry point so callers never see the orbax type error."""
+    import jax
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf) if isinstance(leaf, np.generic) else leaf,
+        state,
+    )
+
+
 def list_checkpoint_steps(
     model_dir: str, require_manifest: bool = True
 ) -> List[int]:
@@ -552,6 +568,7 @@ def save_checkpoint(model_dir: str, step: int, state: Any) -> str:
     import orbax.checkpoint as ocp
 
     path = checkpoint_path(model_dir, step)
+    state = _canonicalize_for_save(state)
     with telemetry.span("checkpoint/save", step=step) as sp:
         if _is_staged(model_dir):
             _staged_save(model_dir, step, state)
@@ -594,6 +611,7 @@ class CheckpointWriter:
         self._executor = None  # staged-upload worker, created on demand
         self._finalizer = None  # manifest writer for async direct saves
         self._staged_futures: list = []
+        self._last_submitted: Optional[Tuple[str, int]] = None
 
     def save(self, model_dir: str, step: int, state: Any) -> str:
         import orbax.checkpoint as ocp
@@ -602,7 +620,21 @@ class CheckpointWriter:
         # async enqueue) — the part the train loop actually stalls on;
         # the background serialization shows up as staged_write / wait.
         with telemetry.span("checkpoint/save_submit", step=step) as sp:
+            if (model_dir, step) == self._last_submitted:
+                # Re-save of the SAME tree: the previous save's commit +
+                # manifest must fully land first — orbax replaces the
+                # directory, and the earlier save's finalizer caught
+                # mid-hash would read files the replace just deleted.
+                # Wait without consuming errors (they surface through
+                # the normal save/wait paths, where multi-host raising
+                # is coordinated).
+                import concurrent.futures
+
+                self._ckptr.wait_until_finished()
+                concurrent.futures.wait(self._staged_futures)
+            self._last_submitted = (model_dir, step)
             self._gc(model_dir)
+            state = _canonicalize_for_save(state)
             path = checkpoint_path(model_dir, step)
             if _is_staged(model_dir):
                 self._staged_async_save(model_dir, step, state)
@@ -777,7 +809,13 @@ def restore_checkpoint_host(model_dir: str, step: int) -> Any:
     with telemetry.span("checkpoint/restore_host", step=step) as sp:
         with _restorable_path(model_dir, step) as path:
             with ocp.PyTreeCheckpointer() as ckptr:
-                item = ckptr.metadata(path).item_metadata
+                # Orbax API drift: metadata() returns the metadata tree
+                # directly on some versions, an object carrying it as
+                # .item_metadata (possibly wrapped in .tree) on others.
+                meta = ckptr.metadata(path)
+                item = getattr(meta, "item_metadata", None)
+                if item is None:
+                    item = meta
                 tree = getattr(item, "tree", item)  # dict of ArrayMetadata leaves
                 restore_args = jax.tree_util.tree_map(
                     lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree

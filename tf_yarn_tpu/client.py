@@ -16,6 +16,7 @@ The differences are architectural, not cosmetic:
 
 from __future__ import annotations
 
+import dataclasses
 import importlib
 import json
 import logging
@@ -32,6 +33,7 @@ from tf_yarn_tpu import _env, constants, event, resilience, telemetry
 from tf_yarn_tpu._internal import MonitoredThread
 from tf_yarn_tpu.resilience import (
     Deadline,
+    ElasticPolicy,
     FailureKind,
     HeartbeatWatchdog,
     RetryPolicy,
@@ -71,11 +73,19 @@ ExperimentFn = Callable[[], object]
 class RunFailed(Exception):
     """Raised when the experiment fails (reference: client.py:89-90).
     Carries the attempt's :class:`~tf_yarn_tpu.resilience.FailureKind`
-    so callers (and the retry loop) can act on *why*."""
+    so callers (and the retry loop) can act on *why*, plus the tasks
+    that died without a lifecycle close (`lost_tasks`) so the elastic
+    resize path can count the hosts that actually went away."""
 
-    def __init__(self, message: str, kind: Optional[FailureKind] = None):
+    def __init__(
+        self,
+        message: str,
+        kind: Optional[FailureKind] = None,
+        lost_tasks: Optional[List[str]] = None,
+    ):
         super().__init__(message)
         self.kind = kind
+        self.lost_tasks = list(lost_tasks or [])
 
 
 @dataclass
@@ -406,8 +416,29 @@ def _execute_and_await_termination(
             f"run final status {status} (classified {kind.value}); "
             f"failed tasks: {sorted(failures) or 'none reported'}\n{details}",
             kind=kind,
+            lost_tasks=_lost_primaries(outcomes, lost_tasks),
         )
     return metrics
+
+
+def _lost_primaries(
+    outcomes: Dict[str, TaskOutcome], lost_tasks: List[str]
+) -> List[str]:
+    """Primary tasks that died without a lifecycle close — what the
+    elastic resize path sizes the shrink off. When the watchdog fired,
+    its heartbeat-silent set is the PRECISE answer (the driver's
+    subsequent handle.kill() leaves every wedged survivor looking
+    equally stop-event-less); otherwise the attempt died organically and
+    the started-but-never-stopped primaries are exactly the silent
+    deaths (SIGKILL, host gone)."""
+    if lost_tasks:
+        return sorted(set(lost_tasks))
+    return sorted(
+        task
+        for task, outcome in outcomes.items()
+        if outcome.status == "KILLED"
+        and task.split(":", 1)[0] in PRIMARY_TASK_TYPES
+    )
 
 
 def _attempt_kind(
@@ -419,18 +450,20 @@ def _attempt_kind(
     retry policy's input): FATAL_USER anywhere beats everything (a
     relaunch reproduces it), a preemption explains collateral losses on
     the same slice, and primaries killed without a stop event are lost
-    tasks."""
+    tasks — counted even when OTHER tasks did report failures, because a
+    surviving worker's collateral crash (its collective peer vanished,
+    so it dies with a ConnectionError classified TRANSIENT) must not
+    mask the lost host that caused it."""
     kinds = [FailureKind.LOST_TASK] * bool(lost_tasks)
     kinds.extend(
         outcome.kind or FailureKind.TRANSIENT for outcome in failures.values()
     )
-    if not failures:
-        kinds.extend(
-            FailureKind.LOST_TASK
-            for task, outcome in outcomes.items()
-            if outcome.status == "KILLED"
-            and task.split(":", 1)[0] in PRIMARY_TASK_TYPES
-        )
+    kinds.extend(
+        FailureKind.LOST_TASK
+        for task, outcome in outcomes.items()
+        if outcome.status == "KILLED"
+        and task.split(":", 1)[0] in PRIMARY_TASK_TYPES
+    )
     return resilience.worst(kinds) or FailureKind.TRANSIENT
 
 
@@ -491,6 +524,7 @@ def run_on_tpu(
     wheels_dir: Optional[str] = None,
     nb_retries: int = 0,
     retry_policy: Optional[RetryPolicy] = None,
+    elastic_policy: Optional[ElasticPolicy] = None,
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
     dead_task_secs: Optional[float] = None,
@@ -513,6 +547,19 @@ def run_on_tpu(
     TPU_YARN_DEAD_TASK_SECS env) arms the heartbeat watchdog: a task
     heartbeat-silent that long fails the attempt as LOST_TASK within a
     poll interval.
+
+    Elastic resize (`elastic_policy=`, docs/Resilience.md "Elastic
+    training"): with an :class:`~tf_yarn_tpu.resilience.ElasticPolicy`,
+    a capacity failure (PREEMPTED / LOST_TASK) RESIZES the relaunch
+    instead of re-requesting the full topology — the 'worker' task
+    type's instance count shrinks to the surviving hosts (never below
+    ``min_workers``), the train loop refits the declared mesh onto the
+    devices the smaller attempt actually has and reshards the restored
+    checkpoint onto it, and per-host input shares rescale so the global
+    batch and the data order stay fixed. A later relaunch for any
+    non-capacity kind grows back to ``max_workers``. Retries still come
+    out of `retry_policy`'s budgets; the resize only changes WHAT
+    relaunches.
 
     `experiment_fn` is a zero-arg closure returning one of the experiment
     types in `tf_yarn_tpu.experiment` (or, with the `distributed` task
@@ -582,6 +629,25 @@ def run_on_tpu(
     serialized_fn = cloudpickle.dumps(experiment_fn)
 
     policy = retry_policy or RetryPolicy.from_nb_retries(nb_retries)
+    current_workers = 0
+    if elastic_policy is not None:
+        if "worker" not in task_specs or task_specs["worker"].instances < 1:
+            raise ValueError(
+                "elastic_policy resizes the 'worker' task type; the "
+                "topology needs a worker spec with instances >= 1 "
+                "(chief and side-cars are never resized)"
+            )
+        current_workers = task_specs["worker"].instances
+        if not (
+            elastic_policy.min_workers
+            <= current_workers
+            <= elastic_policy.max_workers
+        ):
+            raise ValueError(
+                f"initial worker count {current_workers} outside the "
+                f"elastic band [{elastic_policy.min_workers}, "
+                f"{elastic_policy.max_workers}]"
+            )
     # ONE monotonic budget for the whole run: created before the first
     # attempt, never recomputed (the old per-attempt time.time() deadline
     # let nb_retries=3 run 4x timeout_secs, and NTP steps could stretch
@@ -649,6 +715,40 @@ def run_on_tpu(
             telemetry.get_registry().counter(
                 "driver/retries_total", kind=kind.value
             ).inc()
+            if elastic_policy is not None:
+                # Resize-not-retry: a capacity failure relaunches on the
+                # surviving hosts instead of blocking on full capacity;
+                # any other retryable failure is the moment to grow back.
+                lost_workers = sum(
+                    1
+                    for task in getattr(exc, "lost_tasks", None) or []
+                    if task.split(":", 1)[0] == "worker"
+                )
+                new_workers = elastic_policy.plan_resize(
+                    kind, current_workers, lost_tasks=lost_workers
+                )
+                if new_workers is not None:
+                    direction = (
+                        "shrink" if new_workers < current_workers else "grow"
+                    )
+                    _logger.warning(
+                        "elastic resize (%s): relaunching with %d workers "
+                        "(was %d) after %s",
+                        direction, new_workers, current_workers, kind.value,
+                    )
+                    telemetry.get_registry().counter(
+                        "driver/elastic_resizes_total", direction=direction
+                    ).inc()
+                    current_workers = new_workers
+                    task_specs = dict(task_specs)
+                    task_specs["worker"] = dataclasses.replace(
+                        task_specs["worker"], instances=new_workers
+                    )
+                    env = dict(env)
+                    env[constants.ENV_ELASTIC_WORKERS] = str(new_workers)
+                    env[constants.ENV_ELASTIC_MAX_WORKERS] = str(
+                        elastic_policy.max_workers
+                    )
             if delay:
                 time.sleep(delay)
             n_try += 1

@@ -36,3 +36,12 @@ ENV_LOG_DIR = "TPU_YARN_LOG_DIR"
 # Number of processes spawned per host for the task (reference:
 # nb_proc_per_worker, topologies.py:54-94).
 ENV_NB_PROC = "TPU_YARN_NB_PROC"
+
+# Elastic relaunch (resilience.elastic / docs/Resilience.md): set by the
+# driver when an attempt was resized after a capacity failure. WORKERS is
+# the worker count this attempt runs with, MAX the full-capacity count —
+# the train loop refits the declared mesh onto the devices it actually
+# has when these disagree (mesh.resize_mesh_spec) and reports the
+# `train/degraded` gauge from the ratio.
+ENV_ELASTIC_WORKERS = "TPU_YARN_ELASTIC_WORKERS"
+ENV_ELASTIC_MAX_WORKERS = "TPU_YARN_ELASTIC_MAX_WORKERS"

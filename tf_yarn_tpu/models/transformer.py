@@ -476,9 +476,16 @@ class Transformer(nn.Module):
         # microbatch-local stage compute).
         positions_row = positions[:1]
 
+        # Constructed HERE, at the parent apply's trace level: a Module
+        # built inside the shard_map/scan body trips flax's trace-level
+        # check (the active parent scope was opened outside the
+        # transform). `parent=None` keeps it detached — it is driven
+        # through its own .apply with explicit params, never bound.
+        block = Block(cfg, parent=None)
+
         def stage_fn(params_slice, h):
             def layer_body(carry, layer_p):
-                out = Block(cfg).apply(
+                out = block.apply(
                     {"params": layer_p["block"]}, carry, positions_row
                 )
                 return out, None

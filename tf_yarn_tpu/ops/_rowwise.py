@@ -91,6 +91,8 @@ def make_sharded_op(local_fn, rule: str, need_replication: tuple,
     unnecessary here, since the kernels accept arbitrary leading dims
     natively; reshape instead of vmap.
     """
+    import inspect
+
     from jax.experimental.custom_partitioning import custom_partitioning
 
     @custom_partitioning
@@ -101,11 +103,28 @@ def make_sharded_op(local_fn, rule: str, need_replication: tuple,
         arg_shs, out_shs = make_shardings(mesh, arg_shapes, result_shape)
         return mesh, local_fn, out_shs, arg_shs
 
-    wrapped.def_partition(
-        partition=partition,
-        sharding_rule=rule,
-        need_replication_factors=need_replication,
-    )
+    if "sharding_rule" in inspect.signature(
+        custom_partitioning.def_partition
+    ).parameters:
+        # Shardy builds: the einsum-like rule drives propagation.
+        wrapped.def_partition(
+            partition=partition,
+            sharding_rule=rule,
+            need_replication_factors=need_replication,
+        )
+    else:
+        # GSPMD builds (no sharding_rule kwarg): propagation comes from
+        # the infer callback instead — the result sharding is whatever
+        # make_shardings derives from the observed operand shardings,
+        # which encodes the same policy the rule states declaratively.
+        def infer_sharding(mesh, arg_shapes, result_shape):
+            _, out_shs = make_shardings(mesh, arg_shapes, result_shape)
+            return out_shs
+
+        wrapped.def_partition(
+            partition=partition,
+            infer_sharding_from_operands=infer_sharding,
+        )
     return wrapped
 
 

@@ -532,6 +532,47 @@ def _flash_bwd(causal, softmax_scale, block_q, block_k, interpret, residuals, g)
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Local (non-partition-aware) twin of _flash: identical math, but the
+# kernels are invoked directly instead of through custom_partitioning.
+# For callers that are ALREADY per-shard — e.g. ulysses attention calls
+# flash inside its own shard_map, where each shard is one device and the
+# partition wrapper is dead weight (and custom_partitioning primitives
+# cannot be staged under shard_map on every jax build).
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash_local(query, key, value, causal, softmax_scale, block_q, block_k,
+                 interpret):
+    out, _ = _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k,
+        interpret, save_residuals=False,
+    )
+    return out
+
+
+def _flash_local_fwd(query, key, value, causal, softmax_scale, block_q,
+                     block_k, interpret):
+    out, lse = _flash_forward(
+        query, key, value, causal, softmax_scale, block_q, block_k,
+        interpret, save_residuals=True,
+    )
+    return out, (query, key, value, out, lse)
+
+
+def _flash_local_bwd(causal, softmax_scale, block_q, block_k, interpret,
+                     residuals, g):
+    query, key, value, out, lse = residuals
+    return _flash_backward(
+        query, key, value, out, lse, g,
+        causal, softmax_scale, block_q, block_k, interpret,
+    )
+
+
+_flash_local.defvjp(_flash_local_fwd, _flash_local_bwd)
+
+
 def flash_attention(
     query: jax.Array,
     key: jax.Array,
@@ -542,9 +583,14 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    partition_aware: bool = True,
 ) -> jax.Array:
     """Blockwise (flash) attention, differentiable via pallas backward
     kernels that recompute probabilities from the saved log-sum-exp.
+
+    ``partition_aware=False`` skips the custom_partitioning wrappers and
+    calls the kernels directly — for callers that are already per-shard
+    (inside their own shard_map, where every shard is one device).
 
     Default blocks are 512x512 (clamped to the sequence): measured on
     v5e, 128x128 tiles are grid-overhead-bound — 512 is ~1.8x faster at
@@ -563,6 +609,7 @@ def flash_attention(
         from tf_yarn_tpu.ops._rowwise import default_interpret
 
         interpret = default_interpret()
-    return _flash(
+    fn = _flash if partition_aware else _flash_local
+    return fn(
         query, key, value, causal, softmax_scale, block_q, block_k, interpret
     )

@@ -18,6 +18,25 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` across jax versions: new builds expose it at the
+    top level (``check_vma``); older ones only under
+    ``jax.experimental.shard_map`` where the flag is ``check_rep``.
+    Every shard_map in this repo routes through here so the version seam
+    lives in one place."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def all_reduce_mean(x, axis_name: str):
     return jax.lax.pmean(x, axis_name)
 
@@ -80,7 +99,7 @@ def allreduce_bandwidth(
     @jax.jit
     def run(x):
         def body(_, acc):
-            return jax.shard_map(
+            return shard_map(
                 one, mesh=mesh, in_specs=P(axis, None),
                 out_specs=P(axis, None), check_vma=False,
             )(acc)

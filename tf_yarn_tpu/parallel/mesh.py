@@ -85,6 +85,38 @@ class MeshSpec:
         return cls(fsdp=n_devices)
 
 
+def resize_mesh_spec(spec: MeshSpec, n_devices: int) -> MeshSpec:
+    """Refit `spec` onto `n_devices` for an elastic resize
+    (docs/Resilience.md "Elastic training").
+
+    The model axes (tp, sp, ep, pp) are PRESERVED: shrinking them would
+    change parameter placement legality (a tp=4 layer cannot become tp=3)
+    and is never what losing a data-parallel host means. Only the data
+    axes rescale: fsdp keeps as much of its sharding as still divides
+    (optimizer-state memory is why fsdp exists), dp absorbs the rest —
+    so a `dp=4, fsdp=2` mesh on 4 surviving devices becomes
+    `dp=2, fsdp=2`, and on 2 devices `dp=1, fsdp=2`.
+
+    Raises ValueError when `n_devices` cannot host the model axes (not
+    divisible by tp*sp*ep*pp) — that loss is not elastically absorbable;
+    the caller should fail the run rather than silently change the
+    model's parallelism.
+    """
+    model = spec.tp * spec.sp * spec.ep * spec.pp
+    if n_devices < 1:
+        raise ValueError(f"cannot build a mesh over {n_devices} devices")
+    if n_devices % model:
+        raise ValueError(
+            f"elastic resize to {n_devices} devices cannot preserve the "
+            f"model axes (tp={spec.tp} sp={spec.sp} ep={spec.ep} "
+            f"pp={spec.pp} need multiples of {model}); this capacity loss "
+            "is not absorbable by shrinking data parallelism"
+        )
+    data = n_devices // model
+    fsdp = math.gcd(spec.fsdp, data)
+    return dataclasses.replace(spec, dp=data // fsdp, fsdp=fsdp)
+
+
 def select_devices(n: Optional[int] = None, platform: Optional[str] = None):
     """Devices for the mesh. `TPU_YARN_PLATFORM=cpu` (or the `platform`
     arg) forces the virtual CPU platform — the multi-device test rig."""

@@ -128,9 +128,62 @@ def tree_shardings(mesh: Mesh, tree, fsdp_size: Optional[int] = None):
     )
 
 
+def reshard_state(state, new_mesh: Mesh, old_spec=None, shardings=None):
+    """Re-place a train state (params + optimizer pytree) onto `new_mesh`
+    — the elastic-resume primitive (docs/Resilience.md "Elastic
+    training"): after a capacity loss the driver relaunches on fewer
+    devices, the checkpoint restores host-side (or on the old layout),
+    and every leaf moves to the sharding the SAME rules assign on the
+    new mesh. Pure data movement: values are bit-identical before and
+    after, whatever the two mesh shapes are — including uneven shards
+    (a dim that doesn't divide the new axis simply gets a ragged last
+    shard, GSPMD semantics).
+
+    `old_spec` (the previous MeshSpec) is advisory — logged so a resize
+    is visible in task logs; the move itself never needs it because each
+    leaf carries its current placement.
+
+    `shardings` overrides the target placements: callers that already
+    computed the run's sharding tree from the ANNOTATED (boxed) abstract
+    state must pass it — recomputing from `state` here would fall back
+    to FSDP inference (the boxes are gone by restore time) and place
+    annotated params differently than the compiled step expects.
+
+    Leaves already holding the target sharding are left untouched (no
+    transfer, no HBM spike on the common non-resized restore)."""
+    if shardings is None:
+        shardings = tree_shardings(new_mesh, state)
+    if old_spec is not None:
+        new_shape = dict(zip(new_mesh.axis_names, new_mesh.devices.shape))
+        _logger.info(
+            "resharding state: %s -> %s", old_spec, new_shape
+        )
+
+    def _place(leaf, sharding):
+        current = getattr(leaf, "sharding", None)
+        if current is not None and current == sharding:
+            return leaf
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(
+        _place, state, shardings,
+        is_leaf=lambda node: _is_leaf(node),
+    )
+
+
 def unbox_params(tree):
     """Strip flax Partitioned boxes, leaving raw arrays (used after placement
-    decisions are extracted, so apply() sees plain params)."""
-    import flax.linen as nn
+    decisions are extracted, so apply() sees plain params).
 
-    return nn.meta.unbox(tree)
+    Boxes are unwrapped WITHOUT flax's sharding-constraint side effect:
+    under a mesh context `nn.meta.unbox` emits
+    ``with_sharding_constraint(value, PartitionSpec(*names))`` with the
+    *logical* names verbatim, which only works when those names are mesh
+    axes. Ours are logical ("embed", "mlp", ...) and translate through
+    LOGICAL_RULES — placement is applied by the caller (jit
+    out_shardings / device_put from `tree_shardings`), not by the box."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.value if _is_leaf(leaf) else leaf,
+        tree,
+        is_leaf=_is_leaf,
+    )

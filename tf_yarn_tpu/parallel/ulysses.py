@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from tf_yarn_tpu.parallel.collectives import shard_map
 from tf_yarn_tpu.parallel.mesh import (
     AXIS_SP,
     AXIS_TP,
@@ -90,8 +91,11 @@ def ulysses_attention(
     if inner == "flash":
         from tf_yarn_tpu.ops.flash_attention import flash_attention
 
+        # Already per-shard here (inside ulysses' own shard_map): call
+        # the kernels directly, not the custom_partitioning wrapper.
         out = flash_attention(q, k, v, causal=causal,
-                              softmax_scale=softmax_scale)
+                              softmax_scale=softmax_scale,
+                              partition_aware=False)
     else:
         out = xla_attention(q, k, v, causal=causal,
                             softmax_scale=softmax_scale)
@@ -129,7 +133,7 @@ def ulysses_attention_sharded(
         ulysses_attention, causal=causal, softmax_scale=softmax_scale,
         inner=inner,
     )
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),

@@ -11,6 +11,10 @@ recovery — the hot path on preemptible TPU slices:
   jitter backoff, one global monotonic deadline.
 * :mod:`~tf_yarn_tpu.resilience.watchdog` — chief-side dead-task
   detection from heartbeat ages (``TPU_YARN_DEAD_TASK_SECS``).
+* :mod:`~tf_yarn_tpu.resilience.elastic` — resize-not-retry: an
+  :class:`ElasticPolicy` lets a capacity failure shrink the relaunch to
+  the surviving hosts (and grow back later) instead of blocking on full
+  capacity.
 * :mod:`~tf_yarn_tpu.resilience.chaos` — deterministic, seeded fault
   injection (``TPU_YARN_FAULT``) behind the tier-1 kill/recover tests.
 
@@ -25,6 +29,11 @@ from tf_yarn_tpu.resilience.chaos import (  # noqa: F401
     FaultPlan,
     InjectedFault,
     parse_fault_spec,
+)
+from tf_yarn_tpu.resilience.elastic import (  # noqa: F401
+    CAPACITY_KINDS,
+    ElasticPolicy,
+    ElasticResize,
 )
 from tf_yarn_tpu.resilience.retry import (  # noqa: F401
     Deadline,
@@ -46,8 +55,11 @@ from tf_yarn_tpu.resilience.watchdog import (  # noqa: F401
 )
 
 __all__ = [
+    "CAPACITY_KINDS",
     "Deadline",
     "ENV_DEAD_TASK_SECS",
+    "ElasticPolicy",
+    "ElasticResize",
     "FailureKind",
     "FaultPlan",
     "HeartbeatWatchdog",

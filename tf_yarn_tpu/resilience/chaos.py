@@ -12,6 +12,13 @@ Spec grammar (``TPU_YARN_FAULT``, ``;``-separated clauses)::
                           the train loop's host boundary of step N
     sigterm_at_step=N     deliver SIGTERM to this process at step N
                           (exercises the preemption drain path)
+    lose_host_at_step=N   SIGKILL this process at step N — no stop
+                          event, no drain: the driver sees a primary
+                          task killed without a lifecycle close and
+                          classifies the attempt LOST_TASK (the
+                          elastic resize trigger). Optionally
+                          task-qualified (``lose_host_at_step=5@worker:1``)
+                          so exactly one host of a multi-host run dies
     kv_delay=P,SECS       before each KV client op, sleep SECS with
                           probability P (seeded RNG — deterministic
                           per process)
@@ -60,6 +67,8 @@ class FaultPlan:
 
     crash_at_step: Optional[int] = None
     sigterm_at_step: Optional[int] = None
+    lose_host_at_step: Optional[int] = None
+    lose_host_task: Optional[str] = None  # "type:id"; None = every task
     kv_delay: Optional[Tuple[float, float]] = None  # (probability, seconds)
     truncate_ckpt: Optional[str] = None  # "latest"
     seed: int = 0
@@ -68,6 +77,7 @@ class FaultPlan:
         return any((
             self.crash_at_step is not None,
             self.sigterm_at_step is not None,
+            self.lose_host_at_step is not None,
             self.kv_delay is not None,
             self.truncate_ckpt is not None,
         ))
@@ -89,6 +99,11 @@ def parse_fault_spec(spec: str, seed: int = 0) -> FaultPlan:
         try:
             if key in ("crash_at_step", "sigterm_at_step"):
                 fields[key] = int(value)
+            elif key == "lose_host_at_step":
+                step_str, _, task = value.partition("@")
+                fields[key] = int(step_str)
+                if task:
+                    fields["lose_host_task"] = task
             elif key == "kv_delay":
                 prob, _, secs = value.partition(",")
                 fields[key] = (float(prob), float(secs))
@@ -192,6 +207,23 @@ def on_train_step(step: int) -> None:
         inj.fired.add("sigterm")
         _logger.warning("chaos: delivering SIGTERM at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
+    if (
+        plan.lose_host_at_step == step
+        and "lose_host" not in inj.fired
+        and (
+            plan.lose_host_task is None
+            or os.environ.get("TPU_YARN_TASK") == plan.lose_host_task
+        )
+    ):
+        inj.fired.add("lose_host")
+        _logger.warning(
+            "chaos: losing this host (SIGKILL, no stop event) at step %d",
+            step,
+        )
+        # SIGKILL on purpose: a lost host writes no stop event and runs
+        # no drain — the exact signature the LOST_TASK classification
+        # (and the elastic resize path) must be provoked by.
+        os.kill(os.getpid(), signal.SIGKILL)
     if plan.crash_at_step == step and "crash" not in inj.fired:
         inj.fired.add("crash")
         raise InjectedFault(f"chaos: injected crash at step {step}")

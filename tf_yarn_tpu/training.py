@@ -34,7 +34,14 @@ import numpy as np
 import optax
 
 from tf_yarn_tpu import checkpoint as ckpt_lib
-from tf_yarn_tpu import event, fs as fs_lib, preemption, resilience, telemetry
+from tf_yarn_tpu import (
+    constants,
+    event,
+    fs as fs_lib,
+    preemption,
+    resilience,
+    telemetry,
+)
 from tf_yarn_tpu.experiment import CoreExperiment
 from tf_yarn_tpu.parallel import mesh as mesh_lib
 from tf_yarn_tpu.parallel import sharding as sharding_lib
@@ -384,22 +391,37 @@ def _preempt_agreed(state) -> bool:
 def _make_input_iter(input_fn, start_step: int, logger):
     """Build the train iterator, passing `start_step` to input_fns that
     declare it (opt-in input resume — the role tf.data checkpointing
-    plays for the reference's Estimator input_fns)."""
+    plays for the reference's Estimator input_fns).
+
+    Two further opt-in keywords, `host_index` / `num_hosts`, receive this
+    process's slot in the current world: an input_fn that declares them
+    yields its CONTIGUOUS 1/num_hosts share of a fixed global batch
+    (rows [host_index*B/num_hosts : (host_index+1)*B/num_hosts] — the
+    layout `make_array_from_process_local_data` assembles). When an
+    elastic resize changes the host count, each survivor's share
+    rescales while the global batch size and the data order stay fixed
+    — the determinism contract of docs/Resilience.md "Elastic
+    training"."""
     import inspect
 
     try:
-        accepts = "start_step" in inspect.signature(input_fn).parameters
+        params = inspect.signature(input_fn).parameters
     except (TypeError, ValueError):
-        accepts = False
-    if accepts:
-        return iter(input_fn(start_step=start_step))
-    if start_step:
+        params = {}
+    kwargs = {}
+    if "start_step" in params:
+        kwargs["start_step"] = start_step
+    elif start_step:
         logger.info(
             "input_fn takes no start_step: input restarts from the "
             "beginning at resume step %d (declare start_step to skip "
             "already-consumed data)", start_step,
         )
-    return iter(input_fn())
+    if "host_index" in params:
+        kwargs["host_index"] = jax.process_index()
+    if "num_hosts" in params:
+        kwargs["num_hosts"] = jax.process_count()
+    return iter(input_fn(**kwargs))
 
 
 class _ProfileWindow:
@@ -568,15 +590,50 @@ def train_and_evaluate(
     telemetry.enable_env_jsonl(telemetry_task)
     params_cfg = core.train_params
     mesh_spec = core.mesh_spec
+    n_avail = (
+        len(devices) if devices is not None else len(mesh_lib.select_devices())
+    )
+    declared_spec = mesh_spec
+    elastic_resized = False
     if mesh_spec is None:
-        n = len(devices) if devices is not None else len(mesh_lib.select_devices())
-        mesh_spec = mesh_lib.MeshSpec.auto(n)
+        mesh_spec = mesh_lib.MeshSpec.auto(n_avail)
+    elif (
+        os.environ.get(constants.ENV_ELASTIC_WORKERS)
+        and mesh_spec.total_devices != n_avail
+    ):
+        # Elastic relaunch on resized capacity (docs/Resilience.md): the
+        # experiment keeps declaring ONE logical mesh; this attempt owns
+        # a different device count, so refit the data axes onto what is
+        # actually here. Without the driver's elastic env the mismatch
+        # still fails loudly below — a silently smaller mesh on a
+        # non-elastic run would hide a broken reservation.
+        mesh_spec = mesh_lib.resize_mesh_spec(mesh_spec, n_avail)
+        elastic_resized = True
+        _logger.warning(
+            "elastic: declared mesh %s refit onto %d devices -> %s",
+            declared_spec, n_avail, mesh_spec,
+        )
     mesh = mesh_lib.build_mesh(mesh_spec, devices)
     mesh_lib.set_current_mesh(mesh)
     _logger.info(
         "mesh %s over %d devices", dict(zip(mesh.axis_names, mesh.devices.shape)),
         mesh.devices.size,
     )
+    # Capacity gauges ride every registry flush (docs/Observability.md):
+    # mesh_devices is the mesh this attempt computes on; degraded=1 says
+    # an elastic resize is running below the full worker count.
+    _degraded = 0.0
+    if elastic_resized or os.environ.get(constants.ENV_ELASTIC_WORKERS):
+        try:
+            _degraded = float(
+                int(os.environ.get(constants.ENV_ELASTIC_WORKERS, 0))
+                < int(os.environ.get(constants.ENV_ELASTIC_MAX_WORKERS, 0))
+            )
+        except ValueError:
+            _degraded = 0.0
+    registry = telemetry.get_registry()
+    registry.gauge("train/mesh_devices").set(float(mesh.devices.size))
+    registry.gauge("train/degraded").set(_degraded)
 
     # Resume-aware input: discover the resume step BEFORE building the
     # iterator, and hand it to input_fns that opt in with a `start_step`
@@ -624,14 +681,22 @@ def train_and_evaluate(
     abstract_boxed = jax.eval_shape(init_state_boxed, init_rng, first_global)
     state_shardings = _named_shardings(mesh, abstract_boxed)
 
+    # Param init runs OUTSIDE the ambient mesh context below: flax
+    # unboxes Partitioned params inside `init` and, when a global mesh is
+    # defined, emits sharding constraints with the raw logical names
+    # ("embed", "mlp", ...) — which are not physical mesh axes here (our
+    # LOGICAL_RULES translates them; sharding.unbox_params documents the
+    # same hazard). Placement does not need the context either way: the
+    # out_shardings below are explicit NamedShardings carrying the mesh.
+    with telemetry.span("train/init"):
+        init_jit = jax.jit(init_state, out_shardings=state_shardings)
+        state = init_jit(init_rng, first_global)
+
     with mesh, contextlib.ExitStack() as _cleanup:
         # Registered first => runs last: the Chrome-trace export (no-op
         # without TPU_YARN_TRACE) sees every span, including the cleanup
         # callbacks', on success, crash and preemption paths alike.
         _cleanup.callback(telemetry.export_trace, telemetry_task)
-        with telemetry.span("train/init"):
-            init_jit = jax.jit(init_state, out_shardings=state_shardings)
-            state = init_jit(init_rng, first_global)
 
         resume_step = 0
         ckpt_writer = None
@@ -641,7 +706,19 @@ def train_and_evaluate(
                     core.model_dir, target=state
                 )
             if restored is not None:
-                state = restored
+                # Orbax restores into `state`'s shardings (already the
+                # THIS-attempt mesh); reshard_state re-places any leaf
+                # that came back host-side or on a stale layout — the
+                # bit-exact data movement an elastic resume relies on
+                # (values never change, only placement). Targets are the
+                # run's state_shardings (from the BOXED abstract state);
+                # recomputing from the unboxed restore would lose the
+                # logical-axis placements.
+                state = sharding_lib.reshard_state(
+                    restored, mesh,
+                    old_spec=declared_spec if elastic_resized else None,
+                    shardings=state_shardings,
+                )
                 resume_step = int(step)
                 _logger.info("resumed from checkpoint step %d", resume_step)
             # Async writer: save() returns once the state is snapshotted to
@@ -798,6 +875,12 @@ def train_and_evaluate(
             train_iter, place_fn=globalize, depth=2, name="train"
         )
         batch = first_global
+        # Steps already handed to the async writer: a SECOND save of the
+        # same step (final save landing on a checkpoint boundary, drain
+        # on one) would have orbax replace the tree WHILE the first
+        # save's manifest finalizer is still hashing it — the finalizer
+        # reads files the re-save just deleted.
+        last_saved_step = resume_step if resume_step else None
         breakdown = _IntervalBreakdown()
         expected_shapes = tuple(
             a.shape for a in jax.tree_util.tree_leaves(first_global)
@@ -931,7 +1014,9 @@ def train_and_evaluate(
                         with telemetry.span(
                             "train/checkpoint_save", step=step, drain=True
                         ):
-                            ckpt_writer.save(core.model_dir, step, state)
+                            if step != last_saved_step:
+                                ckpt_writer.save(core.model_dir, step, state)
+                                last_saved_step = step
                             ckpt_writer.wait()
                     raise preemption.Preempted(
                         f"preempted at step {step}"
@@ -965,9 +1050,11 @@ def train_and_evaluate(
                     params_cfg.checkpoint_every_steps
                     and step % params_cfg.checkpoint_every_steps == 0
                     and core.model_dir
+                    and step != last_saved_step
                 ):
                     with telemetry.span("train/checkpoint_save", step=step) as sp:
                         ckpt_writer.save(core.model_dir, step, state)
+                        last_saved_step = step
                     breakdown.add("checkpoint_save", sp.duration)
                     if isinstance(tb_writer, _UploadingTbWriter):
                         # TB events survive a SIGKILL up to the last
@@ -1010,7 +1097,11 @@ def train_and_evaluate(
             }
         if core.model_dir:
             with telemetry.span("train/checkpoint_save", step=step, final=True):
-                ckpt_writer.save(core.model_dir, step, state)
+                # Skip the re-save when the cadence already saved this
+                # exact step (the wait still drains its commit).
+                if step != last_saved_step:
+                    ckpt_writer.save(core.model_dir, step, state)
+                    last_saved_step = step
                 ckpt_writer.wait()
         if core.eval_input_fn:
             with telemetry.span("train/eval", final=True):

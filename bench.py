@@ -377,6 +377,14 @@ def _stale_tpu_fields() -> dict:
                 "paged_int8_vs_dense_slots_per_gb"):
         if key in serve:
             fields[f"last_tpu_serve_{key}"] = serve[key]
+    for row_name, row in ((serve.get("spec") or {}).get("rows") or {}).items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            fields[f"last_tpu_serve_spec_{row_name}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            fields[
+                f"last_tpu_serve_spec_{row_name}_accepted_tokens_per_step"
+            ] = row.get("accepted_tokens_per_step")
     fleet = table.get("fleet") or {}
     for row_name, row in (fleet.get("rows") or {}).items():
         if isinstance(row, dict) and "tokens_per_sec" in row:
@@ -656,6 +664,19 @@ def bench_flagship_train():
                         "paged_int8_vs_dense_slots_per_gb"):
                 if key in serve:
                     result[f"serve_{key}"] = serve[key]
+            # Speculative decoding A/B: exact vs k ∈ {2, 4} on the
+            # repeated-structure trace — tokens/s and accepted-tokens
+            # per step are the per-token latency lever's evidence.
+            for row_name, row in (
+                (serve.get("spec") or {}).get("rows") or {}
+            ).items():
+                if isinstance(row, dict) and "tokens_per_sec" in row:
+                    result[f"serve_spec_{row_name}_tokens_per_sec"] = row[
+                        "tokens_per_sec"
+                    ]
+                    result[
+                        f"serve_spec_{row_name}_accepted_tokens_per_step"
+                    ] = row.get("accepted_tokens_per_step")
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
@@ -731,6 +752,16 @@ def _record_cpu_serve_ab(result: dict) -> None:
             result[f"serve_cpu_{layout}_tokens_per_sec"] = row.get(
                 "tokens_per_sec"
             )
+    # Speculative A/B evidence (accepted-tokens/step is a scheduling
+    # property, not device speed — worth recording even CPU-labeled).
+    for row_name, row in ((serve.get("spec") or {}).get("rows") or {}).items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            result[f"serve_cpu_spec_{row_name}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            result[
+                f"serve_cpu_spec_{row_name}_accepted_tokens_per_step"
+            ] = row.get("accepted_tokens_per_step")
     try:
         with open(_AB_PATH) as fh:
             table = json.load(fh)

@@ -421,7 +421,117 @@ def _flash_block_microbench(seq: int):
     return rows
 
 
-def bench_decode(tpu: bool):
+def _spec_decode_ab(tpu: bool, ks=(2, 4)):
+    """Exact vs speculative decoding A/B on ONE seeded repeated-structure
+    trace: the same prompts (each tiling a short motif — the shape
+    n-gram/prompt-lookup drafting exists for: templated/structured
+    traffic) decode through the SAME engine with spec_k = 0 (exact) and
+    spec_k in `ks`, reporting end-to-end tokens/s and accepted-tokens
+    per emitting step. Streams are asserted identical across rows — the
+    speculative path is a latency lever, not a different sampler."""
+    import time
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.serving import SamplingParams, SlotScheduler
+
+    select_devices()
+    if tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            scan_layers=False,
+        )
+        n_requests, max_slots, prompt_len, max_new = 16, 8, 64, 128
+    else:
+        config = TransformerConfig.tiny(scan_layers=False, max_seq_len=128)
+        n_requests, max_slots, prompt_len, max_new = 6, 4, 12, 32
+    model = Transformer(config)
+    rng = np.random.RandomState(7)
+    params = nn.meta.unbox(
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, prompt_len), jnp.int32)
+        )
+    )
+    engine = DecodeEngine(model)
+    # Repeated structure: each prompt tiles a 3-token motif, so the
+    # greedy continuation is (near-)periodic and prompt-lookup drafts
+    # land. One seeded trace shared by every row.
+    prompts = []
+    for _ in range(n_requests):
+        motif = rng.randint(0, config.vocab_size, (3,))
+        prompts.append(
+            np.tile(motif, -(-prompt_len // 3))[:prompt_len].tolist()
+        )
+
+    def run_row(spec_k):
+        scheduler = SlotScheduler(
+            engine, params, max_slots=max_slots,
+            queue_capacity=n_requests, spec_k=spec_k,
+        )
+        scheduler.start()
+        try:
+            # Warmup: compile prefill + the row's step program outside
+            # the timed window.
+            scheduler.submit(
+                prompts[0], SamplingParams(max_new_tokens=2)
+            ).result(timeout=600)
+            t0 = time.perf_counter()
+            responses = [
+                scheduler.submit(p, SamplingParams(max_new_tokens=max_new))
+                for p in prompts
+            ]
+            streams = [r.result(timeout=600) for r in responses]
+            wall = time.perf_counter() - t0
+            # Accepted-tokens per emitting step, from the tick trace
+            # (exact rows have no `accepted` entries: by definition 1).
+            accepted = [
+                n
+                for entry in scheduler.trace
+                for n in entry.get("accepted", {}).values()
+            ]
+            per_step = (
+                round(sum(accepted) / len(accepted), 3) if accepted else 1.0
+            )
+            stats = scheduler.stats()
+            return streams, {
+                "spec_k": spec_k,
+                "tokens_per_sec": round(
+                    n_requests * max_new / wall, 2
+                ),
+                "wall_s": round(wall, 3),
+                "accepted_tokens_per_step": per_step,
+                "accept_rate": (stats.get("spec") or {}).get("accept_rate"),
+            }
+        finally:
+            scheduler.close()
+
+    exact_streams, exact_row = run_row(0)
+    rows = {"exact": exact_row}
+    for k in ks:
+        streams, row = run_row(k)
+        row["streams_match_exact"] = streams == exact_streams
+        row["speedup_vs_exact"] = (
+            round(row["tokens_per_sec"] / exact_row["tokens_per_sec"], 3)
+            if exact_row["tokens_per_sec"] else None
+        )
+        rows[f"k{k}"] = row
+    return {
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "rows": rows,
+    }
+
+
+def bench_decode(tpu: bool, spec: bool = False):
     """Autoregressive decode throughput (tokens/sec), bf16 vs int8 KV
     cache. Decode steps are scanned inside ONE jitted program — per-step
     host dispatch (~5ms through a relay) would otherwise dominate the
@@ -535,10 +645,17 @@ def bench_decode(tpu: bool):
             results[f"engine_error_{cache_dtype}"] = (
                 f"{type(exc).__name__}: {exc}"[:160]
             )
-    return {
+    out = {
         "batch": batch, "prefill": prefill_len,
         "decode_tokens": decode_tokens, **results,
     }
+    if spec:
+        # `decode --spec`: the exact-vs-speculative A/B rides along.
+        try:
+            out["spec"] = _spec_decode_ab(tpu)
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            out["spec"] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    return out
 
 
 def bench_serve(tpu: bool):
@@ -726,6 +843,13 @@ def bench_serve(tpu: bool):
             ratios[f"{name}_vs_dense_slots_per_gb"] = round(
                 spg / dense_spg, 2
             )
+    # Speculative decoding A/B (exact vs k ∈ {2, 4} on one seeded
+    # repeated-structure trace): the per-token latency lever riding on
+    # the same serving stack.
+    try:
+        spec = _spec_decode_ab(tpu)
+    except Exception as exc:  # noqa: BLE001 - record, keep benching
+        spec = {"error": f"{type(exc).__name__}: {exc}"[:160]}
     return {
         "requests": n_requests,
         "max_slots": max_slots,
@@ -736,6 +860,7 @@ def bench_serve(tpu: bool):
         "static": static,
         "continuous_vs_static_speedup": speedup,
         "layouts": layouts,
+        "spec": spec,
         **ratios,
     }
 
@@ -973,6 +1098,10 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("configs", nargs="*", default=list(CONFIGS))
     parser.add_argument("--cpu", action="store_true", help="force tiny CPU shapes")
+    parser.add_argument(
+        "--spec", action="store_true",
+        help="decode config: add the exact-vs-speculative (spec_k) A/B",
+    )
     args = parser.parse_args()
     if args.cpu:
         os.environ["TPU_YARN_PLATFORM"] = "cpu"  # explicit flag wins over env
@@ -983,7 +1112,10 @@ def main() -> None:
         )
     tpu = (not args.cpu) and _on_tpu()
     for name in args.configs:
-        result = CONFIGS[name](tpu)
+        if name == "decode":
+            result = CONFIGS[name](tpu, spec=args.spec)
+        else:
+            result = CONFIGS[name](tpu)
         print(json.dumps({"config": name, "tpu": tpu, **{
             k: round(v, 4) if isinstance(v, float) else v
             for k, v in result.items()

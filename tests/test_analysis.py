@@ -116,6 +116,7 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
         "tf_yarn_tpu/training.py",
         "tf_yarn_tpu/inference.py",
         "tf_yarn_tpu/models/decode_engine.py",
+        "tf_yarn_tpu/models/spec.py",
         "tf_yarn_tpu/tasks/serving.py",
         "tf_yarn_tpu/tasks/router.py",
         "tf_yarn_tpu/checkpoint.py",
@@ -256,6 +257,17 @@ def test_jaxpr_engine_default_entries_clean_on_this_build():
     assert "models.decode_engine.paged_prefill" in counts
     assert counts["models.decode_engine.paged_prefill"][
         "dynamic_update_slice"] > 0
+    # The SPECULATIVE ticks: the windowed verify (accept/reject masking
+    # fully traced) and the FUSED paged verify — findings == [] above
+    # already asserts both are host-callback-free; the fused entry must
+    # actually contain the pallas kernel call (the paged int8 decode-
+    # attention wire-up this gate exists to pin).
+    assert "models.decode_engine.spec_step" in counts
+    assert counts["models.decode_engine.spec_step"]["dot_general"] > 0
+    fused = counts["models.decode_engine.paged_spec_step"]
+    assert fused["dot_general"] > 0
+    assert fused.get("pallas_call", 0) > 0
+    assert fused.get("scatter", 0) > 0
 
 
 def test_finding_format_and_json_roundtrip():

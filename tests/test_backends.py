@@ -151,3 +151,126 @@ def test_ssh_backend_ships_files_and_runs(tmp_path):
     assert shipped[0].read_text() == "hello-from-driver"
     assert shipped[0].parent.name == "payload"
     assert shipped[0].parent.parent.name == "worker-0"
+
+
+def test_ssh_backend_blacklists_dead_hosts_on_relaunch(tmp_path):
+    """PR-8 follow-on: a host whose task was SIGKILLed / heartbeat-silent
+    in the previous attempt (reported via note_lost_tasks) must be
+    excluded from the next launch's placement — an elastic shrink that
+    re-places a task on the dead machine would lose it again."""
+    hosts = [TpuVmHost("tpu-vm-0", 0), TpuVmHost("tpu-vm-1", 1),
+             TpuVmHost("tpu-vm-2", 2)]
+    backend = SshBackend(hosts)
+    captured = []
+
+    def fake_popen(cmd, **kwargs):
+        captured.append(cmd)
+        proc = mock.Mock()
+        proc.poll.return_value = 0
+        proc.returncode = 0
+        proc.pid = 1234
+        return proc
+
+    services = {
+        "chief": ServiceSpec(module="m", instances=1),
+        "worker": ServiceSpec(module="m", instances=2),
+    }
+    with mock.patch("subprocess.Popen", side_effect=fake_popen):
+        backend.launch(services, str(tmp_path))
+    # chief:0 -> vm-0, worker:0 -> vm-1, worker:1 -> vm-2.
+    assert [cmd[-2] for cmd in captured] == ["tpu-vm-0", "tpu-vm-1",
+                                            "tpu-vm-2"]
+
+    # The driver reports worker:1 lost (its host went silent).
+    backend.note_lost_tasks(["worker:1"])
+    assert backend.dead_hosts == ["tpu-vm-2"]
+    # Unknown tasks (never placed) are ignored, not crashed on.
+    backend.note_lost_tasks(["worker:9"])
+    assert backend.dead_hosts == ["tpu-vm-2"]
+
+    # Elastic shrink relaunch: 1 worker — placed on the SURVIVORS only.
+    captured.clear()
+    shrunk = {
+        "chief": ServiceSpec(module="m", instances=1),
+        "worker": ServiceSpec(module="m", instances=1),
+    }
+    with mock.patch("subprocess.Popen", side_effect=fake_popen):
+        backend.launch(shrunk, str(tmp_path))
+    assert [cmd[-2] for cmd in captured] == ["tpu-vm-0", "tpu-vm-1"]
+
+    # The relaunch re-recorded placement: losing worker:0 NOW blames
+    # vm-1 (its current host), not a stale first-attempt assignment.
+    backend.note_lost_tasks(["worker:0"])
+    assert backend.dead_hosts == ["tpu-vm-1", "tpu-vm-2"]
+
+    # Capacity accounting reflects the blacklist: 3 tasks no longer fit.
+    import pytest
+
+    with mock.patch("subprocess.Popen", side_effect=fake_popen):
+        with pytest.raises(ValueError, match="TPU VM hosts"):
+            backend.launch(services, str(tmp_path))
+
+
+def test_ssh_backend_refuses_launch_with_all_hosts_dead(tmp_path):
+    backend = SshBackend([TpuVmHost("tpu-vm-0", 0)])
+    captured = []
+
+    def fake_popen(cmd, **kwargs):
+        captured.append(cmd)
+        proc = mock.Mock()
+        proc.poll.return_value = 0
+        proc.returncode = 0
+        proc.pid = 1
+        return proc
+
+    services = {"worker": ServiceSpec(module="m", instances=1)}
+    with mock.patch("subprocess.Popen", side_effect=fake_popen):
+        backend.launch(services, str(tmp_path))
+    backend.note_lost_tasks(["worker:0"])
+    import pytest
+
+    with pytest.raises(RuntimeError, match="blacklisted"):
+        backend.launch(services, str(tmp_path))
+
+
+def test_driver_feeds_lost_tasks_to_fake_backend():
+    """The client's retry path calls note_lost_tasks with the failed
+    attempt's RunFailed.lost_tasks — verified against a fake backend
+    (the seam SshBackend implements for real)."""
+    from tf_yarn_tpu.backends import SliceBackend
+    from tf_yarn_tpu.client import RunFailed, _note_lost_to_backend
+
+    class FakeBackend(SliceBackend):
+        is_remote = True
+
+        def __init__(self):
+            self.noted = []
+
+        def launch(self, services, log_dir):
+            raise NotImplementedError
+
+        def note_lost_tasks(self, tasks):
+            self.noted.append(list(tasks))
+
+    backend = FakeBackend()
+    _note_lost_to_backend(
+        backend, RunFailed("attempt failed", lost_tasks=["worker:1"])
+    )
+    assert backend.noted == [["worker:1"]]
+    # No lost tasks -> the hook is not called at all.
+    _note_lost_to_backend(backend, RunFailed("attempt failed"))
+    assert backend.noted == [["worker:1"]]
+    # Backends without the hook (duck-typed, pre-hook) are tolerated.
+    _note_lost_to_backend(
+        object(), RunFailed("x", lost_tasks=["worker:0"])
+    )
+    # A hook that raises must not escalate (placement hygiene never
+    # turns a retryable failure fatal).
+
+    class ExplodingBackend(FakeBackend):
+        def note_lost_tasks(self, tasks):
+            raise RuntimeError("boom")
+
+    _note_lost_to_backend(
+        ExplodingBackend(), RunFailed("x", lost_tasks=["worker:0"])
+    )

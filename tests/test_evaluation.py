@@ -93,5 +93,11 @@ def test_continuous_eval_idle_timeout(tmp_path):
 
     t0 = time.time()
     evaluation.continuous_eval(None, experiment, poll_secs=0.1, idle_timeout_secs=1.5)
-    assert time.time() - t0 < 30
+    # Deflaked (PR 7 verification flake): the wall bound only proves the
+    # loop gave up instead of hanging forever — the eval-step jit compile
+    # inside the window can blow a tight bound on a loaded CI box, so it
+    # is deliberately generous. The functional assertion is the
+    # evaluated-set below: step 5 done, the never-appearing final ckpt
+    # abandoned after the 1.5s idle timeout.
+    assert time.time() - t0 < 240
     assert evaluation._evaluated_steps(str(tmp_path)) == {5}

@@ -705,3 +705,44 @@ def test_paged_int8_decode_attention_per_block_scales():
             np.asarray(out)[s], np.asarray(ref)[0], atol=1e-4,
             err_msg=f"slot {s}",
         )
+
+
+def test_paged_int8_window_attention_matches_per_position_kernel():
+    """The speculative-window wrapper: query (slot, w) must equal the
+    single-token paged kernel at effective length lengths[s] + w + 1 —
+    virtual-slot expansion only, no new math. Also pins the causal
+    window semantics: position w sees exactly the prefix plus the
+    window rows up to itself."""
+    from tf_yarn_tpu.ops.decode_attention import (
+        paged_int8_decode_attention,
+        paged_int8_window_attention,
+    )
+
+    slots, width, H, Hkv, D = 2, 3, 8, 4, 64
+    block_size, max_blocks, num_blocks = 32, 4, 14
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(slots, width, H, D), jnp.float32)
+    # lengths = valid prefix BEFORE the window; the window rows
+    # (positions lengths..lengths+width-1) are already in the pool here
+    # (the builder fills every block with data).
+    lengths = np.array([17, 60], np.int32)
+    kp, ksp, vp, vsp, tables, _dense = _build_paged_int8_pool(
+        rng, slots, max_blocks, num_blocks, block_size, Hkv, D
+    )
+    out = paged_int8_window_attention(
+        q, jnp.asarray(kp), jnp.asarray(ksp), jnp.asarray(vp),
+        jnp.asarray(vsp), jnp.asarray(tables), jnp.asarray(lengths),
+    )
+    assert out.shape == (slots, width, H, D)
+    for s in range(slots):
+        for w in range(width):
+            ref = paged_int8_decode_attention(
+                q[s, w][None], jnp.asarray(kp), jnp.asarray(ksp),
+                jnp.asarray(vp), jnp.asarray(vsp),
+                jnp.asarray(tables[s:s + 1]),
+                jnp.asarray([int(lengths[s]) + w + 1], np.int32),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out)[s, w], np.asarray(ref)[0], atol=1e-5,
+                err_msg=f"slot {s} window {w}",
+            )

@@ -40,8 +40,12 @@ def test_span_duration_and_containment():
     tracer = Tracer()
     with tracer.span("outer"):
         with tracer.span("inner"):
-            time.sleep(0.01)
+            time.sleep(0.02)
     inner, outer = tracer.records()
+    # Deflaked (PR 7 verification flake): sleep() and the span's
+    # perf_counter are different clocks — sleep(0.02) can measure a hair
+    # under 0.02 on the span clock, so the bound asserts half the slept
+    # time, which still proves the duration is real.
     assert inner.duration >= 0.01
     assert outer.duration >= inner.duration
     assert outer.start <= inner.start
@@ -226,7 +230,9 @@ def test_heartbeat_broadcasts_and_ages():
     reg = MetricsRegistry()
     reg.gauge("depth").set(3)
     with telemetry.Heartbeat(kv, "worker:0", every=0.05, registry=reg) as hb:
-        deadline = time.time() + 5
+        # Generous deadline (deflake): the beat thread can be starved
+        # well past 2 * every on a loaded CI box.
+        deadline = time.time() + 30
         while hb.beats < 2 and time.time() < deadline:
             time.sleep(0.01)
         # Alive (no tombstone yet): the age is a liveness signal.

@@ -541,6 +541,87 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return fn, args, {}
 
+    def spec_step():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            build_prefill_fn,
+            build_spec_step_fn,
+        )
+
+        model, params, _prompt, _cache = _engine_avals()
+        row = jax.eval_shape(
+            build_prefill_fn(model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0]
+        slots, width = 2, 3
+        grid = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (slots,) + leaf.shape, leaf.dtype
+            ),
+            row,
+        )
+        fn = build_spec_step_fn(
+            model, width, temperature=0.0, top_k=None, top_p=None
+        )
+        args = (
+            params, grid,
+            jax.ShapeDtypeStruct((slots, width), jnp.int32),  # window
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # n_known
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # eos ids
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),     # rngs
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),        # active
+        )
+        return fn, args, {}
+
+    def paged_spec_step():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            _decode_cache_aval,
+            build_paged_spec_step_fn,
+            paged_pool_avals,
+        )
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+        # The FUSED verify forward: decode attention reads the int8
+        # block pool directly through the paged pallas kernel — the
+        # exact program the satellite guardrail pins host-callback-free.
+        config = TransformerConfig.tiny(kv_cache_dtype="int8")
+        model = Transformer(config)
+        prompt = jax.ShapeDtypeStruct((2, 8), jnp.int32)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        params = sharding_lib.unbox_params(
+            jax.eval_shape(lambda r, t: model.init(r, t), rng, prompt)
+        )
+        block_size, slots, width = 8, 2, 3
+        row = _decode_cache_aval(model, params)
+        pool = paged_pool_avals(
+            row, 9, block_size, model.config.max_seq_len
+        )
+        max_blocks = model.config.max_seq_len // block_size
+        fn = build_paged_spec_step_fn(
+            model, block_size, width, temperature=0.0, top_k=None,
+            top_p=None, decode_attention="fused",
+        )
+        args = (
+            params, pool,
+            jax.ShapeDtypeStruct((slots, max_blocks), jnp.int32),
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # lengths
+            jax.ShapeDtypeStruct((slots, width), jnp.int32),  # window
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # n_known
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # eos ids
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),     # rngs
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),        # active
+        )
+        return fn, args, {}
+
     def paged_prefill():
         import jax
         import jax.numpy as jnp
@@ -581,6 +662,16 @@ def _decode_entries() -> List[EntryPoint]:
         EntryPoint("models.decode_engine.paged_step", paged_step),
         # Paged admission's device work: bucketed prefill + block splice.
         EntryPoint("models.decode_engine.paged_prefill", paged_prefill),
+        # The SPECULATIVE ticks: one windowed verify forward advances
+        # every slot up to spec_k + 1 tokens. The accept/reject masking
+        # must be entirely traced — a host callback here would sync the
+        # grid once per window position, not once per tick.
+        EntryPoint("models.decode_engine.spec_step", spec_step),
+        # The fused paged verify: decode attention streams the int8
+        # block pool through the pallas kernel (scalar-prefetched block
+        # tables), scatters the window's quantized K/V rows, and must
+        # stay host-callback-free like every other tick program.
+        EntryPoint("models.decode_engine.paged_spec_step", paged_spec_step),
     ]
 
 

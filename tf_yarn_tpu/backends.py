@@ -95,6 +95,15 @@ class SliceBackend(ABC):
     ) -> ClusterHandle:
         ...
 
+    def note_lost_tasks(self, tasks: List[str]) -> None:
+        """Driver feedback after a failed attempt: these "type:id" tasks
+        died without a lifecycle close (SIGKILLed host, heartbeat-silent
+        past the watchdog). Backends that map tasks onto real machines
+        use it to blacklist the dead machine from the NEXT launch — an
+        elastic shrink that re-places a task on the host that just
+        vanished would lose it again immediately. Default: no-op
+        (LocalBackend's subprocesses share one host)."""
+
 
 class _LocalHandle(ClusterHandle):
     def __init__(
@@ -283,14 +292,47 @@ class SshBackend(SliceBackend):
         ]
         self._tpu_name = tpu_name
         self._zone = zone
+        # Dead-host blacklist (docs/Resilience.md "Elastic training"):
+        # task "type:id" -> hostname from the LAST launch, and the
+        # hostnames the driver reported lost. A blacklisted host is
+        # excluded from every later launch's placement, so an elastic
+        # shrink relaunches on the survivors instead of re-placing a
+        # task on the machine that just went silent.
+        self._last_assignment: Dict[str, str] = {}
+        self._dead_hosts: set = set()
+
+    def note_lost_tasks(self, tasks: List[str]) -> None:
+        for task in tasks:
+            hostname = self._last_assignment.get(task)
+            if hostname is None:
+                continue
+            if hostname not in self._dead_hosts:
+                _logger.warning(
+                    "blacklisting host %s (ran %s, reported lost); it is "
+                    "excluded from later launches", hostname, task,
+                )
+            self._dead_hosts.add(hostname)
+
+    @property
+    def dead_hosts(self) -> List[str]:
+        """The blacklisted hostnames, for introspection/tests."""
+        return sorted(self._dead_hosts)
 
     def _resolve_hosts(self) -> List[TpuVmHost]:
-        if self._hosts is not None:
-            return self._hosts
-        from tf_yarn_tpu.discovery import discover_tpu_vm_hosts
+        if self._hosts is None:
+            from tf_yarn_tpu.discovery import discover_tpu_vm_hosts
 
-        self._hosts = discover_tpu_vm_hosts(self._tpu_name, self._zone)
-        return self._hosts
+            self._hosts = discover_tpu_vm_hosts(self._tpu_name, self._zone)
+        live = [
+            host for host in self._hosts
+            if host.hostname not in self._dead_hosts
+        ]
+        if self._dead_hosts and not live:
+            raise RuntimeError(
+                f"every known host is blacklisted as dead "
+                f"({sorted(self._dead_hosts)}); refusing to launch"
+            )
+        return live
 
     @staticmethod
     def _pack_files(files: Dict[str, str]) -> str:
@@ -365,6 +407,12 @@ class SshBackend(SliceBackend):
         tar_cache: Dict[int, str] = {}
         procs: Dict[TaskKey, subprocess.Popen] = {}
         log_files: Dict[TaskKey, str] = {}
+        # Fresh task->host map per launch: note_lost_tasks consults the
+        # LAST placement (a relaunch may shuffle tasks across hosts).
+        self._last_assignment = {
+            key.to_kv_str(): host.hostname
+            for host, (key, _spec) in zip(hosts, assignments)
+        }
         try:
             # Ship files to every host first, concurrently — launch time
             # stays bounded by the slowest transfer, not the host count.

@@ -47,6 +47,7 @@ import logging
 import os
 import re
 import tempfile
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from tf_yarn_tpu import fs as fs_lib
@@ -612,6 +613,13 @@ class CheckpointWriter:
         self._finalizer = None  # manifest writer for async direct saves
         self._staged_futures: list = []
         self._last_submitted: Optional[Tuple[str, int]] = None
+        # Serializes every _ckptr interaction: orbax's AsyncManager
+        # .wait_until_finished is check-then-join on its worker-thread
+        # attr, so the train thread (save(force=True) waits internally)
+        # racing the manifest finalizer's wait could join a thread the
+        # other caller just nulled (AttributeError: 'NoneType'.join —
+        # seen as a rare tier-1 flake under full-suite load).
+        self._ckptr_lock = threading.Lock()
 
     def save(self, model_dir: str, step: int, state: Any) -> str:
         import orbax.checkpoint as ocp
@@ -630,7 +638,8 @@ class CheckpointWriter:
                 # is coordinated).
                 import concurrent.futures
 
-                self._ckptr.wait_until_finished()
+                with self._ckptr_lock:
+                    self._ckptr.wait_until_finished()
                 concurrent.futures.wait(self._staged_futures)
             self._last_submitted = (model_dir, step)
             self._gc(model_dir)
@@ -639,11 +648,15 @@ class CheckpointWriter:
             if _is_staged(model_dir):
                 self._staged_async_save(model_dir, step, state)
             else:
-                self._ckptr.save(
-                    _orbax_target(model_dir, step),
-                    args=ocp.args.StandardSave(state),
-                    force=True,
-                )
+                # Under the lock: save(force=True) internally waits for
+                # the previous save, which must not race the finalizer
+                # thread's own wait (see _ckptr_lock).
+                with self._ckptr_lock:
+                    self._ckptr.save(
+                        _orbax_target(model_dir, step),
+                        args=ocp.args.StandardSave(state),
+                        force=True,
+                    )
                 self._submit_finalize(model_dir, step)
         _observe_op("save_submit", sp.duration)
         _logger.info("checkpoint %s save started (async)", path)
@@ -669,7 +682,8 @@ class CheckpointWriter:
         # Blocks until every in-flight orbax save (>= this step) has
         # committed; a manifest written later than strictly necessary is
         # fine, one written earlier would mark an incomplete tree.
-        self._ckptr.wait_until_finished()
+        with self._ckptr_lock:
+            self._ckptr.wait_until_finished()
         _commit_manifest(checkpoint_path(model_dir, step), step)
 
     def _staged_async_save(self, model_dir: str, step: int, state: Any) -> None:
@@ -756,7 +770,8 @@ class CheckpointWriter:
     def wait(self) -> None:
         """Block until every started save has committed."""
         with telemetry.span("checkpoint/wait") as sp:
-            self._ckptr.wait_until_finished()
+            with self._ckptr_lock:
+                self._ckptr.wait_until_finished()
             self._raise_staged_errors(block=True)
         _observe_op("wait", sp.duration)
 
@@ -767,7 +782,8 @@ class CheckpointWriter:
             self._finalizer.shutdown(wait=True)
         if self._executor is not None:
             self._executor.shutdown(wait=True)
-        self._ckptr.close()
+        with self._ckptr_lock:
+            self._ckptr.close()
         self._raise_staged_errors(block=True)
 
     def __enter__(self):

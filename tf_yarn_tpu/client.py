@@ -715,6 +715,7 @@ def run_on_tpu(
             telemetry.get_registry().counter(
                 "driver/retries_total", kind=kind.value
             ).inc()
+            _note_lost_to_backend(backend, exc)
             if elastic_policy is not None:
                 # Resize-not-retry: a capacity failure relaunches on the
                 # surviving hosts instead of blocking on full capacity;
@@ -760,6 +761,22 @@ def run_on_tpu(
                 except Exception:  # pragma: no cover - best-effort teardown
                     _logger.debug("coordination server stop failed",
                                   exc_info=True)
+
+
+def _note_lost_to_backend(backend, exc: Exception) -> None:
+    """Feed the failed attempt's lost tasks (SIGKILLed / heartbeat-
+    silent, carried on RunFailed.lost_tasks) back to the backend before
+    the relaunch, so host-placing backends (SshBackend) can blacklist
+    the dead machines from the next attempt's host list. Best-effort:
+    placement hygiene must never turn a retryable failure fatal."""
+    lost = getattr(exc, "lost_tasks", None) or []
+    note = getattr(backend, "note_lost_tasks", None)
+    if not lost or note is None:
+        return
+    try:
+        note(list(lost))
+    except Exception:  # pragma: no cover - diagnostics only
+        _logger.exception("backend.note_lost_tasks failed; continuing")
 
 
 def _shutdown_on_exception(cluster: Optional[SliceCluster], status: str) -> None:

@@ -318,6 +318,18 @@ class ServingExperiment:
     block_size: int = 16
     num_blocks: Optional[int] = None
     prefix_cache_capacity: int = 256
+    # Speculative decoding (docs/Serving.md "Speculative decoding"):
+    # ``spec_k`` drafts per slot per tick (0 = exact path, the
+    # default), proposed by ``spec_draft`` ("ngram" self-draft, or a
+    # callable ``(context, k) -> tokens`` — the draft-model hook) and
+    # verified in one windowed forward; emitted streams are identical
+    # to the exact path, each request just lands up to spec_k + 1
+    # tokens per tick. ``decode_attention="fused"`` runs the paged
+    # verify forward's attention on the paged-int8 pallas kernel
+    # (requires kv_layout="paged" and an int8 KV cache).
+    spec_k: int = 0
+    spec_draft: Any = "ngram"
+    decode_attention: str = "gather"
     # Fleet-router knobs (tf_yarn_tpu/fleet/, docs/Fleet.md), read only
     # by the ``router`` task in a `fleet_topology` — serving replicas
     # ignore them. ``router_policy`` picks the balancing policy
@@ -358,6 +370,23 @@ class ServingExperiment:
             raise ValueError(
                 f"prefix_cache_capacity must be >= 0, got "
                 f"{self.prefix_cache_capacity}"
+            )
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.spec_draft is not None and not callable(self.spec_draft) \
+                and self.spec_draft != "ngram":
+            raise ValueError(
+                "spec_draft must be 'ngram', a callable "
+                f"(context, k) -> tokens, or None; got {self.spec_draft!r}"
+            )
+        if self.decode_attention not in ("gather", "fused"):
+            raise ValueError(
+                f"decode_attention must be 'gather' or 'fused', got "
+                f"{self.decode_attention!r}"
+            )
+        if self.decode_attention == "fused" and self.kv_layout != "paged":
+            raise ValueError(
+                "decode_attention='fused' requires kv_layout='paged'"
             )
         if self.router_policy not in ("round_robin", "least_loaded"):
             raise ValueError(

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 
 from tf_yarn_tpu import telemetry
 from tf_yarn_tpu.models.generate import _sample
+from tf_yarn_tpu.models.spec import verify_window
 
 _logger = logging.getLogger(__name__)
 
@@ -246,6 +247,91 @@ def build_step_fn(model, temperature: float, top_k: Optional[int],
 
 
 # --------------------------------------------------------------------------
+# Speculative decoding: the windowed verify steps
+# --------------------------------------------------------------------------
+#
+# One spec tick advances a slot by a VARIABLE number of tokens: the
+# target model scores all `width` window positions (replay prefix +
+# last token + drafts) in one batched forward, `verify_window`
+# (models/spec.py) keeps exactly the prefix the sequential path would
+# have emitted, and only the accepted positions become valid KV. The
+# forward writes all `width` K/V rows — rejected-draft rows land beyond
+# the slot's valid length, where every decode-attention path masks them
+# to zero weight and the next tick's window overwrites them — so
+# acceptance never needs a device-side KV rollback. Emitted token
+# streams are identical to generate_legacy (token-matching acceptance);
+# note the windowed forward compiles to a different fusion than the
+# one-token step, so float *logits* agree to roundoff, not bitwise —
+# the emitted ints are the contract, and the tests pin them.
+
+
+def _index_leaf_value(cache, max_seq_len: int):
+    """The slot's pre-apply position, read from any index leaf (a cache
+    leaf with no seq axis; all index leaves carry the same scalar)."""
+    for leaf in jax.tree_util.tree_leaves(cache):
+        if _seq_axis(leaf.shape, max_seq_len) is None:
+            return leaf.reshape(-1)[0].astype(jnp.int32)
+    raise ValueError("cache has no index leaf — unknown cache layout")
+
+
+def _with_index(cache, new_index, max_seq_len: int):
+    """Rewrite every index leaf to `new_index` (the accepted length),
+    leaving KV leaves untouched."""
+
+    def leaf(value):
+        if _seq_axis(value.shape, max_seq_len) is None:
+            return jnp.full(value.shape, new_index, value.dtype)
+        return value
+
+    return jax.tree_util.tree_map(leaf, cache)
+
+
+def build_spec_step_fn(model, width: int, temperature: float,
+                       top_k: Optional[int], top_p: Optional[float]):
+    """The dense speculative slot step, shared by the engine and the
+    analysis jaxpr entry point (`models.decode_engine.spec_step`).
+
+        fn(params, slot_cache, tokens [S, W], n_known [S], eos_ids [S],
+           rngs [S, 2], active [S])
+            -> (slot_cache, emitted [S, W], counts [S], rngs)
+
+    ONE compiled program advances every slot up to W tokens: per slot,
+    the target model scores the whole window in one forward (K/V for
+    all W positions appended at the slot's cache_index), verify_window
+    computes the emitted prefix, and the slot's cache_index is rewritten
+    to `old_index + n_known + n_emitted` — the accepted length — so
+    rejected rows are dead weight the next window overwrites. Inactive
+    slots (active=False) emit nothing, consume no RNG, and keep their
+    cache_index; their garbage window rows land in their own (free)
+    cache and are overwritten at the next admission. tokens / n_known /
+    eos_ids are traced, so tick-to-tick changes never recompile.
+    """
+    max_seq_len = model.config.max_seq_len
+
+    def spec_step(params, slot_cache, tokens, n_known, eos_ids, rngs,
+                  active):
+        def one_slot(cache, toks, known, eos_id, rng, act):
+            idx = _index_leaf_value(cache, max_seq_len)
+            logits, state = model.apply(
+                {**params, "cache": cache}, toks[None, :], decode=True,
+                mutable=["cache"],
+            )
+            emitted, count, rng = verify_window(
+                logits[0], toks, known, eos_id, rng, act,
+                temperature, top_k, top_p,
+            )
+            n_valid = jnp.where(act, known + count, 0)
+            cache = _with_index(state["cache"], idx + n_valid, max_seq_len)
+            return cache, emitted, count, rng
+
+        return jax.vmap(one_slot)(
+            slot_cache, tokens, n_known, eos_ids, rngs, active
+        )
+
+    return spec_step
+
+
+# --------------------------------------------------------------------------
 # Paged KV layout: pool avals + the compiled gather/scatter programs
 # --------------------------------------------------------------------------
 
@@ -401,6 +487,183 @@ def build_paged_step_fn(model, block_size: int, temperature: float,
     return step
 
 
+DECODE_ATTENTION_MODES = ("gather", "fused")
+
+
+def _prune_none_tree(tree):
+    """The pool tree minus its None (elided index) entries — the shape
+    flax accepts as the `kv_pool` variable collection (its nested dict
+    structure mirrors the cache collection by construction)."""
+    if isinstance(tree, dict):
+        out = {}
+        for key, value in tree.items():
+            pruned = _prune_none_tree(value)
+            if pruned is None or (isinstance(pruned, dict) and not pruned):
+                continue
+            out[key] = pruned
+        return out
+    return tree
+
+
+def _merge_pool_tree(pool, updated):
+    """Fold the model's updated `kv_pool` collection back into the
+    engine's pool structure (None index leaves restored in place)."""
+    if pool is None:
+        return None
+    if isinstance(pool, dict):
+        return {
+            key: _merge_pool_tree(
+                value, updated[key] if key in updated else None
+            )
+            for key, value in pool.items()
+        }
+    return pool if updated is None else updated
+
+
+def build_paged_spec_step_fn(model, block_size: int, width: int,
+                             temperature: float, top_k: Optional[int],
+                             top_p: Optional[float],
+                             decode_attention: str = "gather"):
+    """The paged speculative slot step, shared by the engine and the
+    analysis jaxpr entry point (`models.decode_engine.paged_spec_step`).
+
+        fn(params, pool, tables, lengths, tokens [S, W], n_known [S],
+           eos_ids [S], rngs [S, 2], active [S])
+            -> (pool, emitted [S, W], counts [S], rngs)
+
+    Same verify semantics as `build_spec_step_fn` over the block pool;
+    the slot's valid length is the HOST's `lengths` bookkeeping (it
+    advances by n_known + n_emitted after the tick), so the program
+    itself needs no index fixup. All `width` freshly written K/V rows
+    scatter back at logical positions length..length+W-1 — rows beyond
+    a slot's reserved blocks hit table entries 0 and land in the trash
+    block, so rejected drafts can never touch another slot's KV.
+
+    `decode_attention` picks the attention implementation inside the
+    verify forward:
+
+    * ``"gather"`` — materialize each slot's dense cache view from the
+      pool (exactly `paged_step`'s path) and run the model's standard
+      decode attention over it. Reference semantics.
+    * ``"fused"`` — int8 pools only: the model's decode attention reads
+      the block pool DIRECTLY through `paged_int8_window_attention`
+      (ops/decode_attention.py — block tables ride in SMEM via scalar
+      prefetch), the window's K/V rows quantize and scatter into the
+      pool before the kernel runs, and no dense per-slot view is ever
+      materialized. Numerics differ from the gather path only by
+      reduction order (tolerance-tested).
+    """
+    if decode_attention not in DECODE_ATTENTION_MODES:
+        raise ValueError(
+            f"decode_attention must be one of {DECODE_ATTENTION_MODES}, "
+            f"got {decode_attention!r}"
+        )
+    max_seq_len = model.config.max_seq_len
+
+    if decode_attention == "fused":
+        if getattr(model.config, "kv_cache_dtype", None) != "int8":
+            raise ValueError(
+                "decode_attention='fused' reads the int8 block pool "
+                "directly (paged_int8_window_attention); it requires "
+                "kv_cache_dtype='int8'"
+            )
+
+        def spec_step_fused(params, pool, tables, lengths, tokens,
+                            n_known, eos_ids, rngs, active):
+            logits, state = model.apply(
+                {**params, "kv_pool": _prune_none_tree(pool)},
+                tokens, decode=True, paged_ctx=(tables, lengths),
+                mutable=["kv_pool"],
+            )
+            pool_out = _merge_pool_tree(pool, dict(state["kv_pool"]))
+
+            def vw(row_logits, toks, known, eos_id, rng, act):
+                return verify_window(
+                    row_logits, toks, known, eos_id, rng, act,
+                    temperature, top_k, top_p,
+                )
+
+            emitted, counts, rngs = jax.vmap(vw)(
+                logits, tokens, n_known, eos_ids, rngs, active
+            )
+            return pool_out, emitted, counts, rngs
+
+        return spec_step_fused
+
+    def spec_step(params, pool, tables, lengths, tokens, n_known,
+                  eos_ids, rngs, active):
+        row_aval = _decode_cache_aval(model, params)
+        blocks_per_slot = tables.shape[1]
+
+        def one_slot(table, length, toks, known, eos_id, rng, act):
+            cache = _gather_slot_cache(
+                pool, row_aval, table, length, max_seq_len
+            )
+            logits, state = model.apply(
+                {**params, "cache": cache}, toks[None, :], decode=True,
+                mutable=["cache"],
+            )
+            emitted, count, rng = verify_window(
+                logits[0], toks, known, eos_id, rng, act,
+                temperature, top_k, top_p,
+            )
+
+            def new_rows(leaf, aval):
+                ax = _seq_axis(aval.shape, max_seq_len)
+                if ax is None:
+                    return None
+                return jax.lax.dynamic_slice_in_dim(
+                    leaf, length, width, axis=ax
+                )
+
+            rows = jax.tree_util.tree_map(new_rows, state["cache"], row_aval)
+            return emitted, count, rng, rows
+
+        emitted, counts, rngs, rows = jax.vmap(one_slot)(
+            tables, lengths, tokens, n_known, eos_ids, rngs, active
+        )
+
+        slots = tables.shape[0]
+
+        def write(pool_leaf, slot_rows, aval):
+            if pool_leaf is None:
+                return None
+            ax = _seq_axis(aval.shape, max_seq_len)
+            for s in range(slots):
+                for w in range(width):
+                    pos = lengths[s] + w
+                    logical = pos // block_size
+                    # Beyond the table (a rejected row past the slot's
+                    # reservation): route to the trash block.
+                    block = jnp.where(
+                        logical < blocks_per_slot,
+                        tables[s, jnp.clip(logical, 0, blocks_per_slot - 1)],
+                        0,
+                    )
+                    offset = pos % block_size
+                    update = jnp.expand_dims(
+                        jax.lax.slice_in_dim(
+                            slot_rows[s], w, w + 1, axis=ax
+                        ),
+                        ax,
+                    )
+                    starts = [jnp.asarray(0, jnp.int32)] * pool_leaf.ndim
+                    starts[ax] = block
+                    starts[ax + 1] = offset
+                    pool_leaf = jax.lax.dynamic_update_slice(
+                        pool_leaf, update.astype(pool_leaf.dtype),
+                        tuple(starts),
+                    )
+            return pool_leaf
+
+        pool_out = jax.tree_util.tree_map(
+            write, pool, rows, row_aval, is_leaf=_is_none
+        )
+        return pool_out, emitted, counts, rngs
+
+    return spec_step
+
+
 def build_pack_prefill_fn(model, block_size: int, prefill_len: int):
     """The prefill->pool splice program: write positions [0, prefill_len)
     of a freshly prefilled batch-1 cache into the slot's first
@@ -514,11 +777,17 @@ class DecodeEngine:
             "paged_step_cache_hits": 0,
             "pack_compiles": 0,
             "pack_cache_hits": 0,
+            "spec_step_compiles": 0,
+            "spec_step_cache_hits": 0,
+            "paged_spec_step_compiles": 0,
+            "paged_spec_step_cache_hits": 0,
             "unbucketed_shapes": 0,
             "oversize_batch_chunks": 0,
         }
         self._paged_step: Dict[tuple, Any] = {}
         self._pack: Dict[tuple, Any] = {}
+        self._spec_step: Dict[tuple, Any] = {}
+        self._paged_spec_step: Dict[tuple, Any] = {}
 
         # Slot-grid splice helpers (continuous batching): donated, so the
         # grid updates HBM in place instead of copying the whole KV store
@@ -709,6 +978,46 @@ class DecodeEngine:
         with telemetry.span("decode_engine/step", slots=slots):
             return compiled(*step_args)
 
+    def spec_step(
+        self,
+        params,
+        slot_cache,
+        tokens,
+        n_known,
+        eos_ids,
+        rngs,
+        active,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        """Advance every slot up to W = tokens.shape[1] tokens in ONE
+        compiled speculative program (build_spec_step_fn). Compiled once
+        per (grid size, window width, sampling config, params
+        fingerprint) — tokens / n_known / eos_ids are traced, so the
+        drafts changing every tick never recompiles. The KV grid and the
+        rng buffer are donated. Returns (slot_cache, emitted [S, W],
+        counts [S], rngs)."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        n_known = jnp.asarray(n_known, jnp.int32)
+        eos_ids = jnp.asarray(eos_ids, jnp.int32)
+        rngs = jnp.asarray(rngs, jnp.uint32)
+        active = jnp.asarray(active, bool)
+        slots, width = (int(tokens.shape[0]), int(tokens.shape[1]))
+        fp = self._params_fingerprint(params)
+        key = ("spec", slots, width, float(temperature), top_k, top_p, fp)
+        fn = build_spec_step_fn(self.model, width, temperature, top_k, top_p)
+        args = (params, slot_cache, tokens, n_known, eos_ids, rngs, active)
+        compiled = self._compiled(
+            self._spec_step, key, "spec_step",
+            lambda: jax.jit(fn, donate_argnums=(1, 5))
+            .lower(*args).compile(),
+        )
+        with telemetry.span("decode_engine/spec_step", slots=slots,
+                            width=width):
+            return compiled(*args)
+
     # -- paged KV slot API ---------------------------------------------------
     #
     # The paged layout (module docstring): a global pool of fixed-size
@@ -817,6 +1126,58 @@ class DecodeEngine:
             .lower(*args).compile(),
         )
         with telemetry.span("decode_engine/paged_step", slots=slots):
+            return compiled(*args)
+
+    def paged_spec_step(
+        self,
+        params,
+        pool,
+        tables,
+        lengths,
+        tokens,
+        n_known,
+        eos_ids,
+        rngs,
+        active,
+        block_size: int,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        decode_attention: str = "gather",
+    ):
+        """Advance every slot up to W = tokens.shape[1] tokens against
+        the block pool in ONE compiled speculative program
+        (build_paged_spec_step_fn; `decode_attention` picks the gather
+        vs fused-kernel verify forward). tables / lengths / tokens /
+        n_known / eos_ids are traced — per-tick changes never recompile.
+        The pool and the rng buffer are donated. Returns (pool, emitted
+        [S, W], counts [S], rngs)."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        tables = jnp.asarray(tables, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        n_known = jnp.asarray(n_known, jnp.int32)
+        eos_ids = jnp.asarray(eos_ids, jnp.int32)
+        rngs = jnp.asarray(rngs, jnp.uint32)
+        active = jnp.asarray(active, bool)
+        slots, width = (int(tokens.shape[0]), int(tokens.shape[1]))
+        key = ("paged_spec", slots, width, tuple(tables.shape), block_size,
+               decode_attention, float(temperature), top_k, top_p,
+               self._params_fingerprint(params),
+               self._tree_fingerprint(pool))
+        fn = build_paged_spec_step_fn(
+            self.model, block_size, width, temperature, top_k, top_p,
+            decode_attention=decode_attention,
+        )
+        args = (params, pool, tables, lengths, tokens, n_known, eos_ids,
+                rngs, active)
+        compiled = self._compiled(
+            self._paged_spec_step, key, "paged_spec_step",
+            lambda: jax.jit(fn, donate_argnums=(1, 7))
+            .lower(*args).compile(),
+        )
+        with telemetry.span("decode_engine/paged_spec_step", slots=slots,
+                            width=width):
             return compiled(*args)
 
     def _tree_fingerprint(self, tree) -> int:

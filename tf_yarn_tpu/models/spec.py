@@ -1,0 +1,192 @@
+"""Speculative decoding: self-draft proposers + the traced window verifier.
+
+The decode engine's exact paths pay one full model step per emitted
+token. Speculative decoding multiplies tokens-per-step without changing
+the emitted stream: a cheap *drafter* proposes ``k`` candidate tokens
+per slot, the target model scores all ``k + 1`` window positions in ONE
+batched forward, and a traced accept/reject pass keeps exactly the
+prefix of drafts the target itself would have emitted. This module
+holds both halves of that split:
+
+* **Host side** — :func:`ngram_propose` / :class:`NGramDrafter`, a
+  prompt-lookup self-drafter over each request's own token history
+  (prompt + everything emitted so far). No second model, no extra
+  checkpoint, no device work: the drafter runs on tokens the host
+  already holds, so proposing is free of device syncs by construction.
+  ``make_drafter`` also accepts any callable ``(context, k) -> tokens``
+  — the ``draft_model=`` hook for a real small model later — and
+  :func:`plan_window` turns a slot's host state (prompt remainder, last
+  token, draft proposals) into the window the device program consumes.
+
+* **Device side** — :func:`verify_window`, the traced accept/reject
+  mask over one slot's window logits. Acceptance is *token-matching*:
+  position ``i``'s draft is accepted iff it equals the token the target
+  would have emitted at position ``i`` under the request's own sampling
+  chain (greedy argmax at temperature 0, the seeded categorical draw
+  otherwise). That is deliberately stricter than classic lenient
+  rejection sampling: every emitted token IS the target chain's own
+  next token, so the emitted stream is identical token-for-token to
+  ``generate_legacy`` — greedy and sampled alike — and the per-request
+  RNG contract (one key split per emitted token) is preserved exactly.
+  The drafts only decide how many of those tokens land per step.
+
+Window layout (shared by the dense and paged spec steps): for a slot
+with ``p`` prompt tokens still replaying, window inputs are
+``pending[:min(p, W)]`` followed by draft proposals; ``n_known`` =
+``min(p - 1, W)`` positions have successors already known (pure replay,
+no emission, no RNG), position ``n_known`` is the first emitting
+position, and the chain dies at the first mismatch or emitted eos.
+``n_known == W`` means the whole window is replay — valid KV, zero
+emissions — so long prompt remainders also advance ``W`` tokens/step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+DrafterFn = Callable[[Sequence[int], int], Sequence[int]]
+
+
+def ngram_propose(
+    context: Sequence[int],
+    k: int,
+    max_ngram: int = 3,
+    min_ngram: int = 1,
+) -> List[int]:
+    """Prompt-lookup proposal: find the most recent earlier occurrence
+    of the context's trailing n-gram (longest n first) and copy the
+    ``k`` tokens that followed it. Returns up to ``k`` tokens — possibly
+    fewer (the match sat near the end) or none (no repeat structure)."""
+    if k <= 0:
+        return []
+    n_ctx = len(context)
+    context = list(context)
+    for n in range(min(max_ngram, n_ctx - 1), min_ngram - 1, -1):
+        suffix = context[n_ctx - n:]
+        # Most recent prior occurrence: scan right-to-left, excluding
+        # the suffix's own position.
+        for start in range(n_ctx - n - 1, -1, -1):
+            if context[start:start + n] == suffix:
+                follow = context[start + n:start + n + k]
+                if follow:
+                    return [int(t) for t in follow]
+    return []
+
+
+class NGramDrafter:
+    """The default self-drafter: :func:`ngram_propose` with fixed n-gram
+    bounds. Stateless and host-pure — safe to share across slots."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"[{min_ngram}, {max_ngram}]"
+            )
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def __call__(self, context: Sequence[int], k: int) -> List[int]:
+        return ngram_propose(
+            context, k, max_ngram=self.max_ngram, min_ngram=self.min_ngram
+        )
+
+
+def make_drafter(spec: Union[str, DrafterFn, None]) -> Optional[DrafterFn]:
+    """Resolve a drafter spec: ``"ngram"`` -> :class:`NGramDrafter`,
+    a callable -> itself (the ``draft_model=`` hook: wrap a real draft
+    model behind ``(context, k) -> tokens``), ``None`` -> no drafting
+    (the spec step still runs, one guaranteed token per tick)."""
+    if spec is None:
+        return None
+    if callable(spec):
+        return spec
+    if spec == "ngram":
+        return NGramDrafter()
+    raise ValueError(
+        f"spec_draft must be 'ngram', a callable (context, k) -> tokens, "
+        f"or None; got {spec!r}"
+    )
+
+
+def plan_window(
+    pending: Sequence[int],
+    last_token: int,
+    width: int,
+    max_emit: int,
+    context: Sequence[int],
+    drafter: Optional[DrafterFn],
+) -> Tuple[List[int], int, int]:
+    """One slot's window inputs for a spec step (host side).
+
+    Returns ``(tokens, n_known, n_proposed)``: ``width`` input tokens
+    (prompt-replay prefix, then up to ``max_emit - 1`` draft proposals,
+    then ``-1`` fill that can never match a real token), the count of
+    positions whose successor is already known, and how many drafts
+    were actually proposed (the accept-rate denominator)."""
+    p = len(pending)
+    if p > 0:
+        take = min(p, width)
+        tokens = [int(t) for t in list(pending)[:take]]
+        n_known = min(p - 1, width)
+    else:
+        tokens = [int(last_token)]
+        n_known = 0
+    draft_room = width - 1 - n_known
+    n_drafts = max(0, min(draft_room, max_emit - 1))
+    proposals: List[int] = []
+    if drafter is not None and n_drafts > 0:
+        proposals = [int(t) for t in drafter(context, n_drafts)][:n_drafts]
+        tokens.extend(proposals)
+    tokens.extend([-1] * (width - len(tokens)))
+    return tokens, n_known, len(proposals)
+
+
+def verify_window(logits, tokens, n_known, eos_id, rng, active,
+                  temperature: float, top_k, top_p):
+    """Traced accept/reject over one slot's window (module docstring).
+
+    ``logits`` [W, V] — the target forward's output at every window
+    position; ``tokens`` [W] — the window inputs (replay prefix, then
+    drafts, then -1 fill); ``n_known``/``eos_id``/``active`` traced
+    scalars (eos_id -1 = none); ``rng`` the slot's uint32[2] key.
+    Returns ``(emitted [W], n_emitted, rng)``: the tokens this step
+    emits, packed from index 0 (entries past ``n_emitted`` are fill),
+    and the key advanced by exactly ``n_emitted`` splits.
+
+    Position ``n_known`` always emits (the exact step's one token —
+    accept-rate 0 degrades to exactly one token per step); position
+    ``i > n_known`` emits iff the chain is alive: every prior draft
+    matched the target's own emission and no emitted token was eos.
+    The W-step loop is unrolled — W is small and static — so the whole
+    pass is branch-free device code: no host syncs, no recompiles from
+    tick-varying ``tokens``/``n_known``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.models.generate import _sample
+
+    width = logits.shape[0]
+    emitted = jnp.zeros((width,), jnp.int32)
+    count = jnp.asarray(0, jnp.int32)
+    emit_prev = jnp.asarray(False)
+    out_prev = jnp.asarray(-1, jnp.int32)
+    for i in range(width):
+        chain_alive = (
+            emit_prev & (tokens[i] == out_prev) & (out_prev != eos_id)
+        )
+        emit_i = active & ((n_known == i) | chain_alive)
+        next_rng, sample_key = jax.random.split(rng)
+        out_i = _sample(
+            logits[i][None], sample_key, temperature, top_k, top_p
+        )[0]
+        rng = jnp.where(emit_i, next_rng, rng)
+        slot_idx = jnp.clip(i - n_known, 0, width - 1)
+        written = jax.lax.dynamic_update_slice(
+            emitted, out_i[None], (slot_idx,)
+        )
+        emitted = jnp.where(emit_i, written, emitted)
+        count = count + emit_i.astype(jnp.int32)
+        emit_prev, out_prev = emit_i, out_i
+    return emitted, count, rng

@@ -195,7 +195,7 @@ class Attention(nn.Module):
     decode: bool = False  # static: KV-cache path (see _ScanBody note)
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, paged_ctx=None):
         cfg = self.config
         decode = self.decode
         b, s, _ = x.shape
@@ -205,7 +205,14 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        if decode:
+        if decode and paged_ctx is not None:
+            # Fused paged decode: the serving engine passed the int8 KV
+            # block pool (kv_pool collection) + per-slot (tables,
+            # lengths). Rows are the batch's slots, the s axis the
+            # speculative window; no dense cache variables exist on
+            # this path at all.
+            out = self._fused_paged_decode(q, k, v, paged_ctx)
+        elif decode:
             # KV cache for autoregressive decoding: append this call's
             # keys/values at cache_index, attend against the whole cache
             # (future slots masked by the offset causal mask).
@@ -305,6 +312,79 @@ class Attention(nn.Module):
         out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
         return LoraDense(cfg.d_model, (HEADS, EMBED), cfg, name="wo")(out)
 
+    def _fused_paged_decode(self, q, k, v, paged_ctx):
+        """Decode attention straight off the paged int8 KV pool: rope at
+        per-slot positions, quantize + scatter this window's K/V rows
+        into the pool, then `paged_int8_window_attention` streams the
+        pool block-by-block (tables in SMEM) — no dense per-slot cache
+        view is ever materialized, and no dense cache variables are
+        created. The pool travels as the mutable ``kv_pool`` collection
+        (per layer; elided index leaves stay host-side as the engine's
+        ``lengths``); tables/lengths ride as the ``paged_ctx`` call
+        argument, broadcast across layers."""
+        cfg = self.config
+        if cfg.kv_cache_dtype != "int8":
+            raise ValueError(
+                "the fused paged decode path reads an int8 pool "
+                "(paged_int8_window_attention); it requires "
+                "kv_cache_dtype='int8'"
+            )
+        from tf_yarn_tpu.ops.decode_attention import (
+            paged_int8_window_attention,
+        )
+        from tf_yarn_tpu.ops.quantize import quantize_int8
+
+        tables, lengths = paged_ctx
+        slots, width = q.shape[0], q.shape[1]
+        positions = (
+            lengths[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+        )
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        k_q, k_s = quantize_int8(k.astype(jnp.float32))
+        v_q, v_s = quantize_int8(v.astype(jnp.float32))
+
+        def _missing():
+            raise ValueError(
+                "fused paged decode needs the kv_pool collection "
+                "(DecodeEngine.paged_spec_step with "
+                "decode_attention='fused' provides it)"
+            )
+
+        pool_vars = {
+            name: self.variable("kv_pool", name, _missing)
+            for name in ("cached_key", "cached_value",
+                         "cached_key_scale", "cached_value_scale")
+        }
+        block_size = pool_vars["cached_key"].value.shape[2]
+        max_blocks = tables.shape[1]
+        logical = positions // block_size
+        # A row past the slot's reserved blocks (a rejected-draft
+        # position) routes to the reserved trash block 0.
+        blocks = jnp.take_along_axis(
+            tables, jnp.clip(logical, 0, max_blocks - 1), axis=1
+        )
+        blocks = jnp.where(logical < max_blocks, blocks, 0).reshape(-1)
+        offsets = (positions % block_size).reshape(-1)
+
+        def scatter(var, rows):
+            # Pool leaves keep the slot-row cache's vestigial batch-1
+            # axis: [1, NB, bs, Hkv, *].
+            pool = var.value[0]
+            rows = rows.reshape((slots * width,) + rows.shape[2:])
+            pool = pool.at[blocks, offsets].set(rows.astype(pool.dtype))
+            var.value = pool[None]
+            return pool
+
+        key_pool = scatter(pool_vars["cached_key"], k_q)
+        value_pool = scatter(pool_vars["cached_value"], v_q)
+        key_scale = scatter(pool_vars["cached_key_scale"], k_s)
+        value_scale = scatter(pool_vars["cached_value_scale"], v_s)
+        return paged_int8_window_attention(
+            q, key_pool, key_scale, value_pool, value_scale, tables,
+            lengths,
+        )
+
 
 class SwiGLU(nn.Module):
     config: TransformerConfig
@@ -324,10 +404,10 @@ class Block(nn.Module):
     decode: bool = False  # static: KV-cache path (see _ScanBody note)
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, paged_ctx=None):
         cfg = self.config
         x = x + Attention(cfg, self.decode, name="attn")(
-            RMSNorm(cfg, name="attn_norm")(x), positions
+            RMSNorm(cfg, name="attn_norm")(x), positions, paged_ctx
         )
         if cfg.moe_experts > 0:
             from tf_yarn_tpu.models.moe import MoEMlp
@@ -349,7 +429,7 @@ class _ScanBody(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, paged_ctx=None):
         block_cls = (
             nn.remat(
                 Block,
@@ -359,7 +439,9 @@ class _ScanBody(nn.Module):
             else Block
         )
         return (
-            block_cls(self.config, self.decode, name="block")(x, positions),
+            block_cls(self.config, self.decode, name="block")(
+                x, positions, paged_ctx
+            ),
             None,
         )
 
@@ -376,7 +458,10 @@ def _make_scanned(cfg: TransformerConfig):
     """
     return nn.scan(
         _ScanBody,
-        variable_axes={"params": 0, "intermediates": 0, "cache": 0},
+        # kv_pool: the fused paged decode path's per-layer KV block pool
+        # slice (absent everywhere else — an empty collection is free).
+        variable_axes={"params": 0, "intermediates": 0, "cache": 0,
+                       "kv_pool": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=nn.broadcast,
         length=cfg.n_layers,
@@ -396,9 +481,14 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, deterministic: bool = True,
-                 return_hidden: bool = False, decode: bool = False):
+                 return_hidden: bool = False, decode: bool = False,
+                 paged_ctx=None):
         # deterministic accepted for loss-contract uniformity (this
-        # decoder family carries no dropout).
+        # decoder family carries no dropout). `paged_ctx` = (block
+        # tables [S, MB], lengths [S]) switches decode attention onto
+        # the fused paged path (Attention._fused_paged_decode): rows
+        # are serving slots, the kv_pool collection holds the int8
+        # block pool.
         cfg = self.config
         embedding = self.param(
             "embedding",
@@ -414,10 +504,14 @@ class Transformer(nn.Module):
         if cfg.gpipe_microbatches > 0 and not decode:
             x = self._gpipe_layers(x, positions)
         elif cfg.scan_layers:
-            x, _ = _make_scanned(cfg)(cfg, decode, name="layers")(x, positions)
+            x, _ = _make_scanned(cfg)(cfg, decode, name="layers")(
+                x, positions, paged_ctx
+            )
         else:
             for i in range(cfg.n_layers):
-                x = _ScanBody(cfg, decode, name=f"layer_{i}")(x, positions)[0]
+                x = _ScanBody(cfg, decode, name=f"layer_{i}")(
+                    x, positions, paged_ctx
+                )[0]
 
         x = RMSNorm(cfg, name="final_norm")(x)
         head = self.param(

@@ -365,3 +365,51 @@ def paged_int8_decode_attention(
             )
         ),
     )(block_tables, lengths, query, kf, ks, vf, vs)
+
+
+def paged_int8_window_attention(
+    query: jax.Array,
+    key_pool: jax.Array,
+    key_scale: jax.Array,
+    value_pool: jax.Array,
+    value_scale: jax.Array,
+    block_tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """W-token-window decode attention straight off the paged int8 pool
+    — the speculative-verify companion of `paged_int8_decode_attention`.
+
+    query [S, W, H, D] (W window positions per slot), pools/scales/
+    block_tables as above, lengths [S] = each slot's valid length
+    BEFORE the window. Precondition: the window's own K/V rows are
+    already scattered into the pool at logical positions
+    ``lengths[s] + w`` — window position ``w`` then attends causally
+    over ``lengths[s] + w + 1`` pool positions (prefix + the window
+    prefix up to and including itself), exactly the mask the sequential
+    one-token path applies.
+
+    Implementation: each (slot, window) pair becomes a *virtual slot*
+    of the single-token kernel — query row ``s*W + w`` walks slot `s`'s
+    block table with effective length ``lengths[s] + w + 1``. The pool
+    streams block-by-block per virtual slot with the table in SMEM; no
+    dense per-slot cache view is ever materialized. (The W queries of
+    one slot re-stream that slot's blocks independently — acceptable
+    for the small W speculative decoding uses; a multi-query kernel
+    row-tiling the window is the follow-on if W grows.)"""
+    slots, width, n_heads, head_dim = query.shape
+    virtual_q = query.reshape(slots * width, n_heads, head_dim)
+    virtual_tables = jnp.repeat(block_tables, width, axis=0)
+    virtual_lengths = (
+        lengths[:, None]
+        + 1
+        + jnp.arange(width, dtype=jnp.int32)[None, :]
+    ).reshape(-1)
+    out = paged_int8_decode_attention(
+        virtual_q, key_pool, key_scale, value_pool, value_scale,
+        virtual_tables, virtual_lengths,
+        softmax_scale=softmax_scale, interpret=interpret,
+    )
+    return out.reshape(slots, width, n_heads, head_dim)

@@ -13,6 +13,15 @@ online server (docs/Serving.md):
   prompt-prefix cache.
 * :mod:`~tf_yarn_tpu.serving.paging` — host-side block-pool free list /
   refcounts and the prefix-cache LRU behind the paged layout.
+
+  The scheduler also carries the speculative path (``spec_k > 0``): a
+  host-side self-drafter proposes tokens per slot, one compiled
+  windowed program verifies them (``models/spec.py``), and each tick
+  advances a variable number of tokens per slot — token streams stay
+  identical to the exact path. ``decode_attention="fused"`` swaps the
+  paged verify forward's attention onto the
+  ``paged_int8_decode_attention`` kernel (reads the block pool
+  directly; int8 pools only).
 * :mod:`~tf_yarn_tpu.serving.server` — the threaded stdlib HTTP
   frontend (``/v1/generate``, ``/healthz``, ``/stats``) and
   `run_serving`, the body of the ``serving`` task type.

@@ -54,6 +54,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.models.spec import make_drafter, plan_window
 from tf_yarn_tpu.serving.paging import BlockPool, PrefixCache
 from tf_yarn_tpu.serving.request import (
     FINISH_DEADLINE,
@@ -75,13 +76,14 @@ _logger = logging.getLogger(__name__)
 IDLE_POLL_S = 0.05
 
 KV_LAYOUTS = ("dense", "paged")
+DECODE_ATTENTION = ("gather", "fused")
 
 
 class _Slot:
     """Host-side state of one occupied decode slot."""
 
     __slots__ = ("request", "response", "pending", "last_token", "emitted",
-                 "blocks")
+                 "blocks", "context")
 
     def __init__(self, request: Request, response: Response,
                  pending: List[int], blocks: Optional[List[int]] = None):
@@ -95,6 +97,10 @@ class _Slot:
         # Paged layout only: the physical block ids this slot holds one
         # reference on (shared prefix blocks included).
         self.blocks = blocks
+        # The request's full token history (prompt + emissions) — the
+        # speculative drafter's lookup corpus. Appended to only on the
+        # windowed path.
+        self.context: List[int] = list(request.prompt)
 
 
 class SlotScheduler:
@@ -111,6 +117,16 @@ class SlotScheduler:
     entries in the shared-prefix LRU (0 disables prefix sharing);
     ``max_seq_len`` overrides the engine-derived context bound (fake
     engines in tests have no model config).
+
+    Speculative knobs (docs/Serving.md "Speculative decoding"):
+    ``spec_k`` drafts per slot per tick (0 = the exact paths above);
+    ``spec_draft`` the proposer ("ngram" self-draft, or a callable
+    ``(context, k) -> tokens`` — the draft-model hook);
+    ``decode_attention`` = "gather" (reference) or "fused" (paged int8
+    pools read directly by the pallas kernel inside the verify
+    forward). Emitted streams are identical to the exact path; each
+    tick just advances 1..spec_k+1 tokens per slot, and
+    ``context_limit`` shrinks by ``spec_k`` (window scratch headroom).
     """
 
     def __init__(
@@ -130,12 +146,27 @@ class SlotScheduler:
         num_blocks: Optional[int] = None,
         prefix_cache_capacity: int = 256,
         max_seq_len: Optional[int] = None,
+        spec_k: int = 0,
+        spec_draft="ngram",
+        decode_attention: str = "gather",
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if kv_layout not in KV_LAYOUTS:
             raise ValueError(
                 f"kv_layout must be one of {KV_LAYOUTS}, got {kv_layout!r}"
+            )
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if decode_attention not in DECODE_ATTENTION:
+            raise ValueError(
+                f"decode_attention must be one of {DECODE_ATTENTION}, "
+                f"got {decode_attention!r}"
+            )
+        if decode_attention == "fused" and kv_layout != "paged":
+            raise ValueError(
+                "decode_attention='fused' streams the paged block pool "
+                "directly; it requires kv_layout='paged'"
             )
         self.engine = engine
         self.params = params
@@ -144,6 +175,17 @@ class SlotScheduler:
         self.top_k = top_k
         self.top_p = top_p
         self.kv_layout = kv_layout
+        self.spec_k = int(spec_k)
+        self.decode_attention = decode_attention
+        # Speculative decoding (docs/Serving.md): window width = the
+        # last token (or replay prefix) + spec_k drafts. The windowed
+        # tick also carries the fused-attention path at width 1, so
+        # decode_attention="fused" alone routes through it.
+        self._spec_width = self.spec_k + 1
+        self._windowed = self.spec_k > 0 or decode_attention == "fused"
+        self._drafter = make_drafter(spec_draft) if self.spec_k > 0 else None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self.queue = AdmissionQueue(queue_capacity, retry_after_s)
         self._rngs = np.zeros((max_slots, 2), np.uint32)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
@@ -212,9 +254,14 @@ class SlotScheduler:
 
     @property
     def context_limit(self) -> Optional[int]:
-        """Max prompt + max_new_tokens this grid can serve (the slot KV
-        size), or None when unknown (fake engines without a config)."""
-        return self._max_seq_len
+        """Max prompt + max_new_tokens this grid can serve, or None when
+        unknown (fake engines without a config). Speculative decoding
+        reserves `spec_k` positions of KV headroom per slot: a window
+        writes all spec_k + 1 rows before acceptance is known, so the
+        last tick's rejected rows must still land inside the cache."""
+        if self._max_seq_len is None:
+            return None
+        return self._max_seq_len - self.spec_k
 
     def submit(
         self,
@@ -243,13 +290,19 @@ class SlotScheduler:
             prompt=tuple(prompt), params=params, priority=priority,
             timeout_s=timeout_s,
         )
-        if self._max_seq_len is not None and (
-            len(request.prompt) + params.max_new_tokens > self._max_seq_len
+        limit = self.context_limit
+        if limit is not None and (
+            len(request.prompt) + params.max_new_tokens > limit
         ):
+            headroom = (
+                f" minus the spec_k={self.spec_k} window headroom"
+                if self.spec_k else ""
+            )
             raise ValueError(
                 f"prompt ({len(request.prompt)}) + max_new_tokens "
                 f"({params.max_new_tokens}) exceeds the model's "
-                f"max_seq_len ({self._max_seq_len}) — the slot KV size"
+                f"max_seq_len ({self._max_seq_len}){headroom} — the slot "
+                "KV size"
             )
         if self.kv_layout == "paged":
             need = self._blocks_needed(request)
@@ -290,9 +343,13 @@ class SlotScheduler:
             with telemetry.span("serving/admit"):
                 self._admit(now, admitted)
             active = [s for s in range(self.max_slots) if self._slots[s]]
+            accepts = None
             if active:
                 with telemetry.span("serving/step", active=len(active)):
-                    self._step(active, retired)
+                    if self._windowed:
+                        accepts = self._step_spec(active, retired)
+                    else:
+                        self._step(active, retired)
         worked = bool(active or admitted or retired)
         if worked:
             self._ticks += 1
@@ -300,13 +357,18 @@ class SlotScheduler:
                 tick_span.duration
             )
             self._registry.counter("serving/ticks_total").inc()
-            self.trace.append({
+            entry = {
                 "tick": self._ticks,
                 "admitted": admitted,
                 "retired": [(rid, reason) for rid, reason in retired],
                 "active": len([s for s in self._slots if s is not None]),
                 "queued": self.queue.depth,
-            })
+            }
+            if accepts is not None:
+                # Tokens emitted per request this tick (1 = the exact
+                # step's pace; > 1 = accepted drafts landed).
+                entry["accepted"] = accepts
+            self.trace.append(entry)
         self._registry.gauge("serving/active_slots").set(
             len([s for s in self._slots if s is not None])
         )
@@ -519,6 +581,108 @@ class SlotScheduler:
             elif state.emitted >= state.request.params.max_new_tokens:
                 self._retire(slot, FINISH_LENGTH, retired)
 
+    def _step_spec(self, active: List[int], retired: List) -> Dict[int, int]:
+        """The speculative tick: ONE compiled windowed program advances
+        every slot a VARIABLE number of tokens (1 up to spec_k + 1).
+        Drafts come from the host-side drafter over each slot's own
+        token history; replay prefixes ride in the same window, so a
+        long prompt remainder also advances up to the full window per
+        tick. Returns {request id: tokens emitted} for the trace ring.
+        """
+        width = self._spec_width
+        tokens = np.full((self.max_slots, width), -1, np.int32)
+        n_known = np.zeros((self.max_slots,), np.int32)
+        eos_ids = np.full((self.max_slots,), -1, np.int32)
+        mask = np.zeros((self.max_slots,), bool)
+        consumed: Dict[int, int] = {}
+        proposed: Dict[int, int] = {}
+        for slot in active:
+            state = self._slots[slot]
+            max_emit = state.request.params.max_new_tokens - state.emitted
+            window, known, n_prop = plan_window(
+                state.pending, state.last_token, width, max_emit,
+                state.context, self._drafter,
+            )
+            tokens[slot] = window
+            n_known[slot] = known
+            eos = state.request.params.eos_token
+            eos_ids[slot] = -1 if eos is None else eos
+            mask[slot] = True
+            consumed[slot] = min(len(state.pending), width)
+            proposed[slot] = n_prop
+        if self.kv_layout == "paged":
+            self._pool, emitted, counts, rngs = self.engine.paged_spec_step(
+                self.params, self._pool, self._tables, self._lengths,
+                tokens, n_known, eos_ids, self._rngs, mask,
+                block_size=self._block_size,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p,
+                decode_attention=self.decode_attention,
+            )
+        else:
+            self._cache, emitted, counts, rngs = self.engine.spec_step(
+                self.params, self._cache, tokens, n_known, eos_ids,
+                self._rngs, mask,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p,
+            )
+        # The tick's host sync: every slot's window + counts at once.
+        emitted = np.asarray(emitted)
+        counts = np.asarray(counts)
+        self._rngs = np.array(rngs)
+        accepts: Dict[int, int] = {}
+        for slot in active:
+            state = self._slots[slot]
+            for _ in range(consumed[slot]):
+                state.pending.popleft()
+            n = int(counts[slot])
+            if self.kv_layout == "paged":
+                # Valid rows this tick: the replayed prefix + the
+                # emitted tokens; rejected window rows beyond stay dead.
+                self._lengths[slot] += int(n_known[slot]) + n
+            if proposed[slot]:
+                accepted_drafts = min(max(n - 1, 0), proposed[slot])
+                self._spec_proposed += proposed[slot]
+                self._spec_accepted += accepted_drafts
+                self._registry.counter(
+                    "serving/spec_proposed_tokens_total"
+                ).inc(proposed[slot])
+                if accepted_drafts:
+                    self._registry.counter(
+                        "serving/spec_accepted_tokens_total"
+                    ).inc(accepted_drafts)
+            if n:
+                accepts[state.request.id] = n
+                self._registry.histogram(
+                    "serving/accepted_tokens_per_step"
+                ).observe(n)
+            for j in range(n):
+                token = int(emitted[slot, j])
+                state.last_token = token
+                state.emitted += 1
+                state.context.append(token)
+                first = state.response.first_token_at is None
+                state.response._push(token)
+                if first:
+                    self._registry.histogram(
+                        "serving/ttft_seconds"
+                    ).observe(state.response.ttft_s)
+                self._registry.counter(
+                    "serving/tokens_generated_total"
+                ).inc()
+                eos = state.request.params.eos_token
+                if eos is not None and token == eos:
+                    self._retire(slot, FINISH_EOS, retired)
+                    break
+                if state.emitted >= state.request.params.max_new_tokens:
+                    self._retire(slot, FINISH_LENGTH, retired)
+                    break
+        if self._spec_proposed:
+            self._registry.gauge("serving/spec_accept_rate").set(
+                self._spec_accepted / self._spec_proposed
+            )
+        return accepts
+
     def _retire(self, slot: int, reason: str, retired: List) -> None:
         state = self._slots[slot]
         self._slots[slot] = None
@@ -625,7 +789,17 @@ class SlotScheduler:
             "kv_layout": self.kv_layout,
             "kv_cache_hbm_bytes": self._kv_bytes,
             "draining": self._draining,
+            "spec_k": self.spec_k,
+            "decode_attention": self.decode_attention,
         }
+        if self._windowed:
+            snap["spec"] = {
+                "proposed_tokens": self._spec_proposed,
+                "accepted_tokens": self._spec_accepted,
+                "accept_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4
+                ) if self._spec_proposed else None,
+            }
         if self.kv_layout == "paged":
             snap["block_size"] = self._block_size
             snap["block_pool"] = {

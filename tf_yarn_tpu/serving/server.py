@@ -285,6 +285,9 @@ def run_serving(experiment, runtime=None) -> dict:
         block_size=experiment.block_size,
         num_blocks=experiment.num_blocks,
         prefix_cache_capacity=experiment.prefix_cache_capacity,
+        spec_k=experiment.spec_k,
+        spec_draft=experiment.spec_draft,
+        decode_attention=experiment.decode_attention,
     )
     server = ServingServer(scheduler, experiment.host, experiment.port)
     scheduler.start()

@@ -385,6 +385,19 @@ def _stale_tpu_fields() -> dict:
             fields[
                 f"last_tpu_serve_spec_{row_name}_accepted_tokens_per_step"
             ] = row.get("accepted_tokens_per_step")
+    tp_ab = serve.get("tp") or {}
+    for row_name, row in (tp_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            fields[f"last_tpu_serve_tp_{row_name}_tokens_per_sec"] = row[
+                "tokens_per_sec"
+            ]
+            fields[
+                f"last_tpu_serve_tp_{row_name}_kv_hbm_bytes_per_device"
+            ] = row.get("kv_hbm_bytes_per_device")
+    if "kv_per_device_ratio" in tp_ab:
+        fields["last_tpu_serve_tp_kv_per_device_ratio"] = tp_ab[
+            "kv_per_device_ratio"
+        ]
     fleet = table.get("fleet") or {}
     for row_name, row in (fleet.get("rows") or {}).items():
         if isinstance(row, dict) and "tokens_per_sec" in row:
@@ -636,7 +649,7 @@ def bench_flagship_train():
         except Exception as exc:
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
         try:
-            serve = suite.bench_serve(tpu=True)
+            serve = suite.bench_serve(tpu=True, tp=True)
             ab["serve"] = serve
             _write_ab(ab)
             # Online-serving headline pair: continuous-batching
@@ -677,6 +690,22 @@ def bench_flagship_train():
                     result[
                         f"serve_spec_{row_name}_accepted_tokens_per_step"
                     ] = row.get("accepted_tokens_per_step")
+            # Tensor-parallel A/B: tokens/s per tp degree plus the
+            # per-device KV residency ratio (the capacity-per-chip
+            # claim; on a 1-chip rig the section records its skip note).
+            tp_ab = serve.get("tp") or {}
+            for row_name, row in (tp_ab.get("rows") or {}).items():
+                if isinstance(row, dict) and "tokens_per_sec" in row:
+                    result[f"serve_tp_{row_name}_tokens_per_sec"] = row[
+                        "tokens_per_sec"
+                    ]
+                    result[
+                        f"serve_tp_{row_name}_kv_hbm_bytes_per_device"
+                    ] = row.get("kv_hbm_bytes_per_device")
+            if "kv_per_device_ratio" in tp_ab:
+                result["serve_tp_kv_per_device_ratio"] = tp_ab[
+                    "kv_per_device_ratio"
+                ]
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
@@ -734,7 +763,7 @@ def _record_cpu_serve_ab(result: dict) -> None:
     line."""
     try:
         suite = _load_bench_suite()
-        serve = suite.bench_serve(tpu=False)
+        serve = suite.bench_serve(tpu=False, tp=True)
     except Exception as exc:  # the bench headline must still print
         _log(f"cpu serve bench FAILED: {type(exc).__name__}: {exc}")
         return
@@ -762,6 +791,19 @@ def _record_cpu_serve_ab(result: dict) -> None:
             result[
                 f"serve_cpu_spec_{row_name}_accepted_tokens_per_step"
             ] = row.get("accepted_tokens_per_step")
+    # Tensor-parallel accounting (per-device KV is a placement
+    # property, not device speed — the CPU rig's evidence is real; its
+    # tokens/s ratio is NOT, and the section's note says so).
+    tp_ab = serve.get("tp") or {}
+    for row_name, row in (tp_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            result[
+                f"serve_cpu_tp_{row_name}_kv_hbm_bytes_per_device"
+            ] = row.get("kv_hbm_bytes_per_device")
+    if "kv_per_device_ratio" in tp_ab:
+        result["serve_cpu_tp_kv_per_device_ratio"] = tp_ab[
+            "kv_per_device_ratio"
+        ]
     try:
         with open(_AB_PATH) as fh:
             table = json.load(fh)

@@ -531,6 +531,133 @@ def _spec_decode_ab(tpu: bool, ks=(2, 4)):
     }
 
 
+def _tp_serve_ab(tpu: bool, tp=2):
+    """Tensor-parallel decode A/B on ONE seeded Poisson trace: the same
+    requests serve through a tp=1 engine and a tp=`tp` engine (weights
+    placed by the logical rules, paged KV pool sharded by kv-heads),
+    reporting tokens/s and the per-DEVICE resident KV bytes. Streams
+    are asserted identical across rows — sharding is a placement
+    change, not a sampler change. On the CPU rig the tp "devices" are
+    threads contending on one socket, so the SPEED ratio there is NOT
+    evidence; the per-device HBM accounting is (the claim tp exists
+    for: a model bigger than one chip serving online)."""
+    import time
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu import inference
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+    from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+
+    from tf_yarn_tpu.serving import SamplingParams, SlotScheduler
+
+    devices = select_devices()
+    if len(devices) < tp:
+        return {
+            "skipped": (
+                f"needs {tp} devices, have {len(devices)} — set "
+                f"TPU_YARN_VIRTUAL_DEVICES={tp} (or run on a slice) "
+                "before jax initializes"
+            ),
+        }
+    if tpu:
+        config = TransformerConfig(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            scan_layers=False,
+        )
+        n_requests, max_slots, prompt_len, max_new = 16, 8, 64, 128
+        block_size = 16
+    else:
+        # f32 on the CPU rig: a random-init bf16 model's logits sit on
+        # a ~1e-3 grid, so greedy near-ties flip under ANY reduction
+        # regrouping (sharded or not — the paged-vs-legacy tests pin
+        # f32 for the same reason); f32 keeps the match flag meaningful.
+        config = TransformerConfig.tiny(
+            scan_layers=False, max_seq_len=128, dtype=jnp.float32,
+        )
+        n_requests, max_slots, prompt_len, max_new = 6, 4, 12, 24
+        block_size = 8
+    model = Transformer(config)
+    rng = np.random.RandomState(11)
+    params = nn.meta.unbox(
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, prompt_len), jnp.int32)
+        )
+    )
+    prompts = [
+        rng.randint(0, config.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n_requests)
+    ]
+    worst_tokens = prompt_len + max_new - 1
+    num_blocks = max_slots * (-(-worst_tokens // block_size)) + 1
+
+    def run_row(degree):
+        mesh = None
+        row_params = params
+        if degree > 1:
+            mesh = build_mesh(MeshSpec(tp=degree), devices[:degree])
+            row_params = inference.shard_restored_params(
+                model, params, mesh
+            )
+        engine = DecodeEngine(model, mesh=mesh)
+        scheduler = SlotScheduler(
+            engine, row_params, max_slots=max_slots,
+            queue_capacity=n_requests, kv_layout="paged",
+            block_size=block_size, num_blocks=num_blocks,
+        )
+        scheduler.start()
+        try:
+            scheduler.submit(
+                prompts[0], SamplingParams(max_new_tokens=2)
+            ).result(timeout=600)
+            t0 = time.perf_counter()
+            responses = [
+                scheduler.submit(p, SamplingParams(max_new_tokens=max_new))
+                for p in prompts
+            ]
+            streams = [r.result(timeout=600) for r in responses]
+            wall = time.perf_counter() - t0
+            stats = scheduler.stats()
+            return streams, {
+                "tp": degree,
+                "tokens_per_sec": round(n_requests * max_new / wall, 2),
+                "wall_s": round(wall, 3),
+                "kv_hbm_bytes": stats["kv_cache_hbm_bytes"],
+                "kv_hbm_bytes_per_device": stats[
+                    "kv_cache_hbm_bytes_per_device"
+                ],
+            }
+        finally:
+            scheduler.close()
+
+    base_streams, base_row = run_row(1)
+    tp_streams, tp_row = run_row(tp)
+    tp_row["streams_match_tp1"] = tp_streams == base_streams
+    return {
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "rows": {"tp1": base_row, f"tp{tp}": tp_row},
+        "kv_per_device_ratio": (
+            round(
+                tp_row["kv_hbm_bytes_per_device"]
+                / base_row["kv_hbm_bytes_per_device"], 3
+            )
+            if base_row["kv_hbm_bytes_per_device"] else None
+        ),
+        "note": (
+            "CPU-rig tokens/s ratios are socket contention, not "
+            "evidence; the per-device KV accounting is the claim"
+        ),
+    }
+
+
 def bench_decode(tpu: bool, spec: bool = False):
     """Autoregressive decode throughput (tokens/sec), bf16 vs int8 KV
     cache. Decode steps are scanned inside ONE jitted program — per-step
@@ -658,7 +785,7 @@ def bench_decode(tpu: bool, spec: bool = False):
     return out
 
 
-def bench_serve(tpu: bool):
+def bench_serve(tpu: bool, tp: bool = False):
     """Online-serving A/B matrix under ONE seeded Poisson arrival trace:
 
     * **policy** — continuous batching (freed slots re-admitted next
@@ -850,7 +977,7 @@ def bench_serve(tpu: bool):
         spec = _spec_decode_ab(tpu)
     except Exception as exc:  # noqa: BLE001 - record, keep benching
         spec = {"error": f"{type(exc).__name__}: {exc}"[:160]}
-    return {
+    out = {
         "requests": n_requests,
         "max_slots": max_slots,
         "total_tokens": total_tokens,
@@ -863,6 +990,15 @@ def bench_serve(tpu: bool):
         "spec": spec,
         **ratios,
     }
+    if tp:
+        # Tensor-parallel A/B (`serve --tp`): tp=1 vs tp=2 on the same
+        # seeded trace; the per-device KV accounting is the evidence,
+        # CPU-rig speed ratios are not (see _tp_serve_ab).
+        try:
+            out["tp"] = _tp_serve_ab(tpu)
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            out["tp"] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    return out
 
 
 def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
@@ -1102,9 +1238,20 @@ def main() -> None:
         "--spec", action="store_true",
         help="decode config: add the exact-vs-speculative (spec_k) A/B",
     )
+    parser.add_argument(
+        "--tp", action="store_true",
+        help="serve config: add the tp=1 vs tp=2 tensor-parallel A/B",
+    )
     args = parser.parse_args()
     if args.cpu:
         os.environ["TPU_YARN_PLATFORM"] = "cpu"  # explicit flag wins over env
+    if args.tp:
+        # The tp A/B needs >= 2 devices; on a CPU rig that means forcing
+        # virtual host-platform devices BEFORE jax initializes
+        # (parallel.mesh.select_devices reads this env and appends the
+        # XLA flag). A real slice already has its chips; the setdefault
+        # is harmless there.
+        os.environ.setdefault("TPU_YARN_VIRTUAL_DEVICES", "4")
     unknown = [name for name in args.configs if name not in CONFIGS]
     if unknown:
         parser.error(
@@ -1114,6 +1261,8 @@ def main() -> None:
     for name in args.configs:
         if name == "decode":
             result = CONFIGS[name](tpu, spec=args.spec)
+        elif name == "serve":
+            result = CONFIGS[name](tpu, tp=args.tp)
         else:
             result = CONFIGS[name](tpu)
         print(json.dumps({"config": name, "tpu": tpu, **{

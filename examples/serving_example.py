@@ -18,6 +18,12 @@ self-drafter proposes tokens per slot, one windowed program verifies
 them, and the repeated-structure request in the burst lands multiple
 tokens per tick — the printed trace shows the per-tick accepted
 counts, and the streams are identical to the exact path.
+
+`python examples/serving_example.py --tp` runs TENSOR-PARALLEL decode
+(docs/Serving.md "Tensor-parallel decode"): the weights and the paged
+KV pool shard across 2 (virtual, on CPU) devices, XLA inserts the TP
+all-reduces from the placements, and the streams are identical to the
+single-device run — the printout shows per-device vs global KV bytes.
 """
 
 import http.client
@@ -28,9 +34,18 @@ import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("TPU_YARN_PLATFORM", os.environ.get("EXAMPLE_PLATFORM", "cpu"))
+if "--tp" in sys.argv[1:] and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # Must land before the first jax call in this process: the tp demo
+    # needs 2 devices; on the CPU platform that means virtual host
+    # devices (the same switch the test rig's conftest flips).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
 
 
-def main(spec: bool = False) -> None:
+def main(spec: bool = False, tp: bool = False) -> None:
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -38,6 +53,7 @@ def main(spec: bool = False) -> None:
 
     from tf_yarn_tpu.models.decode_engine import DecodeEngine
     from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
     from tf_yarn_tpu.serving import ServingServer, SlotScheduler
 
     config = TransformerConfig.tiny(max_seq_len=64, scan_layers=False)
@@ -45,8 +61,18 @@ def main(spec: bool = False) -> None:
     params = nn.meta.unbox(
         model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     )
+    mesh = None
+    if tp:
+        # Tensor-parallel replica: weights placed by the logical-axis
+        # rules, slot KV sharded by kv-heads — the serving task does
+        # exactly this from ServingExperiment(mesh_spec=MeshSpec(tp=2)).
+        from tf_yarn_tpu import inference
+
+        mesh = build_mesh(MeshSpec(tp=2), select_devices(2))
+        params = inference.shard_restored_params(model, params, mesh)
     engine = DecodeEngine(
-        model, batch_buckets=(1, 2, 4), prompt_buckets=(4, 8, 16)
+        model, batch_buckets=(1, 2, 4), prompt_buckets=(4, 8, 16),
+        mesh=mesh,
     )
 
     # Paged KV slots: a global pool of 8-token blocks instead of one
@@ -63,9 +89,13 @@ def main(spec: bool = False) -> None:
     scheduler.start()
     server = ServingServer(scheduler, "127.0.0.1", 0)
     server.start()
+    stats0 = scheduler.stats()
     print(f"serving on {server.endpoint} (grid of {scheduler.max_slots} "
-          f"paged slots, {scheduler.stats()['kv_cache_hbm_bytes']} KV bytes"
-          + (f", spec_k={scheduler.spec_k}" if spec else "") + ")")
+          f"paged slots, {stats0['kv_cache_hbm_bytes']} KV bytes"
+          + (f", spec_k={scheduler.spec_k}" if spec else "")
+          + (f", tp={stats0['tp_degree']}: "
+             f"{stats0['kv_cache_hbm_bytes_per_device']} KV bytes/device"
+             if tp else "") + ")")
 
     rng = np.random.RandomState(0)
     motif = rng.randint(0, 256, 3)
@@ -211,4 +241,4 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         fleet()
     else:
-        main(spec="--spec" in sys.argv[1:])
+        main(spec="--spec" in sys.argv[1:], tp="--tp" in sys.argv[1:])

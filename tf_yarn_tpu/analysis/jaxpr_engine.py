@@ -80,6 +80,12 @@ def capabilities() -> frozenset:
     import jax
 
     caps = set()
+    # The sharded decode entries lower tp=2 programs and need two real
+    # devices (a CPU rig gets them via
+    # --xla_force_host_platform_device_count); single-device installs
+    # skip those entries with a notice instead of failing them.
+    if len(jax.devices()) >= 2:
+        caps.add("multi_device")
     if hasattr(jax, "shard_map"):
         caps.add("jax.shard_map")
     else:
@@ -647,6 +653,118 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return prefill_and_pack, args, {}
 
+    def _tp_sharded(paged: bool):
+        """The TENSOR-PARALLEL serving tick, lowered exactly as the
+        engine lowers it: params placed by the logical-axis rules, the
+        slot grid / block pool sharded by kv-heads over `tp`, explicit
+        in/out shardings on the jit. The TP collectives themselves are
+        inserted by the XLA partitioner at compile (they are not jaxpr
+        primitives), so this entry verifies what the trace CAN see —
+        any named-axis collective stays inside the declared tp axis
+        env, and the program is host-callback-free; the compiled-HLO
+        all-reduce presence is pinned by tests/test_tp_serving.py."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from tf_yarn_tpu.models.decode_engine import (
+            _decode_cache_aval,
+            build_paged_step_fn,
+            build_prefill_fn,
+            build_step_fn,
+            kv_partition_spec,
+            paged_pool_avals,
+            pool_partition_spec,
+        )
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+        from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        tp = 2
+        config = TransformerConfig.tiny()
+        model = Transformer(config)
+        mesh = build_mesh(MeshSpec(tp=tp), jax.devices()[:tp])
+        rep = NamedSharding(mesh, PartitionSpec())
+        abstract = jax.eval_shape(
+            lambda r, t: model.init(r, t),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        )
+        param_sh = sharding_lib.tree_shardings(mesh, abstract)
+        params = sharding_lib.unbox_params(abstract)
+        max_seq = config.max_seq_len
+        slots = 2
+        if paged:
+            block_size = 8
+            row = _decode_cache_aval(model, params)
+            pool = paged_pool_avals(row, 9, block_size, max_seq)
+            pool_sh = jax.tree_util.tree_map(
+                lambda aval, r: (
+                    None if aval is None else NamedSharding(
+                        mesh,
+                        pool_partition_spec(tuple(r.shape), max_seq, tp),
+                    )
+                ),
+                pool, row, is_leaf=lambda x: x is None,
+            )
+            max_blocks = max_seq // block_size
+            fn = jax.jit(
+                build_paged_step_fn(
+                    model, block_size=block_size, temperature=0.0,
+                    top_k=None, top_p=None,
+                ),
+                in_shardings=(param_sh, pool_sh, rep, rep, rep, rep, rep),
+                out_shardings=(pool_sh, rep, rep),
+            )
+            args = (
+                params, pool,
+                jax.ShapeDtypeStruct((slots, max_blocks), jnp.int32),
+                jax.ShapeDtypeStruct((slots,), jnp.int32),
+                jax.ShapeDtypeStruct((slots,), jnp.int32),
+                jax.ShapeDtypeStruct((slots, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((slots,), jnp.bool_),
+            )
+            return fn, args, {}
+        row = jax.eval_shape(
+            build_prefill_fn(model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0]
+        grid = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (slots,) + leaf.shape, leaf.dtype
+            ),
+            row,
+        )
+        grid_sh = jax.tree_util.tree_map(
+            lambda aval: NamedSharding(
+                mesh, kv_partition_spec(tuple(aval.shape), max_seq, tp)
+            ),
+            grid,
+        )
+        fn = jax.jit(
+            build_step_fn(model, temperature=0.0, top_k=None, top_p=None),
+            in_shardings=(param_sh, grid_sh, rep, rep, rep),
+            out_shardings=(grid_sh, rep, rep),
+        )
+        args = (
+            params, grid,
+            jax.ShapeDtypeStruct((slots,), jnp.int32),
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),
+        )
+        return fn, args, {}
+
+    def sharded_step():
+        return _tp_sharded(paged=False)
+
+    def sharded_paged_step():
+        return _tp_sharded(paged=True)
+
+    from tf_yarn_tpu.parallel.mesh import AXIS_TP
+
     return [
         EntryPoint("models.decode_engine.prefill", prefill),
         EntryPoint("models.decode_engine.decode_loop", decode_loop),
@@ -672,6 +790,22 @@ def _decode_entries() -> List[EntryPoint]:
         # tables), scatters the window's quantized K/V rows, and must
         # stay host-callback-free like every other tick program.
         EntryPoint("models.decode_engine.paged_spec_step", paged_spec_step),
+        # The TENSOR-PARALLEL serving ticks (tp=2): params placed by
+        # LOGICAL_RULES, slot KV sharded by heads, explicit in/out
+        # shardings — traced under the declared tp axis env so any
+        # named-axis collective that appears is vocabulary-checked, and
+        # host-callback-freedom is asserted like every tick program.
+        # Needs >= 2 devices (skipped with a notice on 1-device rigs).
+        EntryPoint(
+            "models.decode_engine.sharded_step", sharded_step,
+            axis_env=((AXIS_TP, 2),), expected_axes=(AXIS_TP,),
+            requires=("multi_device",),
+        ),
+        EntryPoint(
+            "models.decode_engine.sharded_paged_step", sharded_paged_step,
+            axis_env=((AXIS_TP, 2),), expected_axes=(AXIS_TP,),
+            requires=("multi_device",),
+        ),
     ]
 
 

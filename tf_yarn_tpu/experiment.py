@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional
 
-from tf_yarn_tpu.parallel.mesh import MeshSpec
+from tf_yarn_tpu.parallel.mesh import AXIS_TP, MeshSpec
 
 Batch = Dict[str, Any]
 LossFn = Callable[..., Any]  # (model, params, batch, rng) -> (loss, aux)
@@ -300,6 +300,18 @@ class ServingExperiment:
     ``max_seq_len`` cache per slot). ``num_blocks=None`` sizes the pool
     at dense-equivalent capacity; shrink it to realize the HBM saving
     (``prefix_cache_capacity=0`` disables prefix sharing).
+
+    ``mesh_spec`` turns on TENSOR-PARALLEL decode (docs/Serving.md
+    "Tensor-parallel decode"): ``MeshSpec(tp=N)`` places the replica's
+    weights by the transformer's logical-axis rules and shards the slot
+    KV (dense grid or paged block pool) by kv-heads over the ``tp``
+    mesh axis, so a model bigger than one chip's HBM serves online —
+    still ONE compiled program and one host sync per tick. Serving
+    shards tensor-parallel only: every other mesh axis must stay 1 (use
+    the fleet router for replica parallelism). Config errors — a head
+    count not divisible by tp, or ``decode_attention="fused"`` with
+    tp > 1 (the pallas kernel cannot read a sharded pool yet) — fail
+    HERE, at build time, not as an opaque trace-time partitioner error.
     """
 
     model: Any
@@ -330,6 +342,10 @@ class ServingExperiment:
     spec_k: int = 0
     spec_draft: Any = "ngram"
     decode_attention: str = "gather"
+    # Tensor-parallel decode (docs/Serving.md "Tensor-parallel decode"):
+    # MeshSpec(tp=N) shards this replica's weights and slot KV across N
+    # devices. None (default) = single-device decode, exactly as before.
+    mesh_spec: Optional[MeshSpec] = None
     # Fleet-router knobs (tf_yarn_tpu/fleet/, docs/Fleet.md), read only
     # by the ``router`` task in a `fleet_topology` — serving replicas
     # ignore them. ``router_policy`` picks the balancing policy
@@ -388,6 +404,44 @@ class ServingExperiment:
             raise ValueError(
                 "decode_attention='fused' requires kv_layout='paged'"
             )
+        if self.mesh_spec is not None:
+            # Reject bad TP configs HERE — before any restore/trace —
+            # with errors that name the knob, not the XLA partitioner's
+            # symptom. The device-availability check happens where the
+            # devices are (parallel.mesh.select_devices raises "need N
+            # devices, have M" when the serving task builds the mesh).
+            spec = self.mesh_spec
+            other = {
+                name: size
+                for name, size in zip(spec.axis_names, spec.axis_sizes)
+                if name != AXIS_TP and size != 1
+            }
+            if other:
+                raise ValueError(
+                    f"serving shards tensor-parallel only: mesh_spec "
+                    f"axes {other} must be 1 (replica parallelism is "
+                    "the fleet router's job — docs/Fleet.md)"
+                )
+            tp = spec.tp
+            config = getattr(self.model, "config", None)
+            if tp > 1:
+                for name in ("n_heads", "n_kv_heads"):
+                    value = getattr(config, name, None)
+                    if value is not None and value % tp:
+                        raise ValueError(
+                            f"mesh_spec tp={tp} does not divide the "
+                            f"model's {name}={value}; tensor-parallel "
+                            "decode shards attention (and the KV "
+                            "cache) by heads"
+                        )
+                if self.decode_attention == "fused":
+                    raise ValueError(
+                        f"decode_attention='fused' cannot run with "
+                        f"mesh_spec tp={tp}: the paged-int8 pallas "
+                        "kernel reads the whole block pool in one "
+                        "program and cannot read a sharded pool yet; "
+                        "use decode_attention='gather' or tp=1"
+                    )
         if self.router_policy not in ("round_robin", "least_loaded"):
             raise ValueError(
                 f"router_policy must be 'round_robin' or 'least_loaded', "

@@ -104,6 +104,39 @@ def _restore_params(model_dir: str, step: Optional[int]):
     return params, step
 
 
+def shard_restored_params(model, variables, mesh):
+    """The SHARDED restore path (docs/Serving.md "Tensor-parallel
+    decode"): place a host-restored variables dict onto `mesh` with the
+    placements the model's logical-axis annotations assign.
+
+    Checkpoints store raw arrays — the flax Partitioned boxes (and so
+    the logical names "heads"/"mlp"/"vocab"/...) are gone by restore
+    time — so the names come from an abstract re-init of the model
+    (`jax.eval_shape`, no FLOPs, no device memory) and map through
+    parallel.sharding.LOGICAL_RULES exactly as training placement does.
+    Every leaf lands as one `device_put`; a variables dict that does
+    not match the model's init structure fails loudly here, before any
+    compile."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+    try:
+        abstract = jax.eval_shape(
+            lambda rng, tokens: model.init(rng, tokens),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        )
+    except Exception as exc:
+        raise ValueError(
+            f"cannot abstractly init {type(model).__name__} to recover "
+            f"its logical-axis annotations for the sharded restore: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+    return sharding_lib.shard_like_annotated(mesh, abstract, variables)
+
+
 class _JsonlWriter:
     """Bounded background JSONL writer (stage 3 of the pipeline).
 

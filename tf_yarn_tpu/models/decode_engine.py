@@ -34,6 +34,22 @@ eos id, the PRNG seed) are traced scalars, so they never force a
 recompile; only shapes and the sampling configuration (temperature /
 top_k / top_p are baked into the traced program) key the cache.
 
+* **Tensor-parallel decode.** Constructed with a ``mesh``
+  (docs/Serving.md "Tensor-parallel decode"), the engine serves a model
+  bigger than one chip's HBM: params place by the transformer's
+  logical-axis rules (attention heads / MLP hidden / vocab over the
+  ``tp`` mesh axis), every slot KV cache and the paged block pool shard
+  their kv-heads axis over ``tp`` (`kv_partition_spec` /
+  `pool_partition_spec` — each device holds 1/tp of every slot and
+  every block), and all the compiled programs lower with explicit
+  in/out shardings so the XLA partitioner inserts the attention-output
+  and MLP down-projection all-reduces from the placements alone. No
+  scheduler logic changes: still ONE program and one host sync per
+  tick, tables/lengths/tokens still traced, and emitted token streams
+  identical to the single-device path (float logits agree to roundoff —
+  the partitioned matmuls reduce in a different grouping; the emitted
+  ints are the tested contract, as with speculative decoding below).
+
 * **Paged KV slots.** The serving grid's dense per-slot caches (each a
   full `max_seq_len` allocation, mostly padding for short requests) have
   a paged alternative: ONE global pool of fixed-size KV blocks
@@ -390,6 +406,12 @@ def _is_none(x) -> bool:
     return x is None
 
 
+def _is_named_sharding(sharding) -> bool:
+    from jax.sharding import NamedSharding
+
+    return isinstance(sharding, NamedSharding)
+
+
 def _gather_slot_cache(pool, row_aval, table, length, max_seq_len):
     """One slot's dense cache view: KV leaves gathered from the pool by
     the block table (and reshaped back to the dense seq axis), index
@@ -710,7 +732,8 @@ def build_pack_prefill_fn(model, block_size: int, prefill_len: int):
 
 def cache_nbytes(tree) -> int:
     """Resident bytes of a cache pytree (dense slot grid or paged pool;
-    None leaves — elided index leaves — count zero)."""
+    None leaves — elided index leaves — count zero). GLOBAL bytes: a
+    tp-sharded tree's per-device share is `tree_nbytes_per_device`."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         size = 1
@@ -718,6 +741,87 @@ def cache_nbytes(tree) -> int:
             size *= dim
         total += size * jnp.dtype(leaf.dtype).itemsize
     return total
+
+
+def tree_nbytes_per_device(tree) -> int:
+    """Resident bytes of a pytree on EACH device: sharded leaves count
+    one shard (`Sharding.shard_shape`), replicated/host leaves count
+    whole. With no mesh this equals `cache_nbytes` — the number the
+    `serving/kv_cache_hbm_bytes_per_device` gauge and the tp HBM
+    accounting tests read."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = tuple(leaf.shape)
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(shape)
+        size = 1
+        for dim in shape:
+            size *= dim
+        total += size * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+# --------------------------------------------------------------------------
+# Tensor-parallel decode: the KV placement rule
+# --------------------------------------------------------------------------
+#
+# Under a tp mesh (docs/Serving.md "Tensor-parallel decode") the slot
+# KV lives sharded: every cache leaf's kv-heads axis — the axis right
+# after the sequence axis in the model's [*, seq, kv_heads, head_dim]
+# cache layout (scales ride as [*, seq, kv_heads, 1]) — splits over the
+# `tp` mesh axis, so each device holds 1/tp of every slot's cache (and
+# of every paged block). Index leaves and layouts whose heads dim does
+# not divide stay replicated. Weights place through the transformer's
+# EXISTING logical-axis rules (parallel/sharding.py LOGICAL_RULES):
+# attention heads + MLP hidden + vocab over tp, the rest replicated on
+# a serving mesh — XLA then inserts the attention-output and MLP
+# down-projection all-reduces from the shardings alone; no step-program
+# logic changes.
+
+
+def kv_partition_spec(shape: Tuple[int, ...], max_seq_len: int, tp: int):
+    """PartitionSpec for a DENSE cache leaf (prefill row, slot row, or
+    slot grid — the rule anchors on the seq axis, so the extra leading
+    slot/layer axes need no special casing)."""
+    from jax.sharding import PartitionSpec
+
+    from tf_yarn_tpu.parallel.mesh import AXIS_TP
+
+    if tp <= 1:
+        return PartitionSpec()
+    ax = _seq_axis(shape, max_seq_len)
+    if ax is None:
+        return PartitionSpec()
+    heads = ax + 1
+    if heads >= len(shape) or shape[heads] % tp:
+        return PartitionSpec()
+    spec = [None] * len(shape)
+    spec[heads] = AXIS_TP
+    return PartitionSpec(*spec)
+
+
+def pool_partition_spec(row_shape: Tuple[int, ...], max_seq_len: int,
+                        tp: int):
+    """The same heads-axis rule for a PAGED pool leaf, whose seq axis
+    was split into (num_blocks, block_size) — computed from the dense
+    ROW leaf's shape (the pool shape cannot anchor on max_seq_len), with
+    every axis after the split shifted one right."""
+    from jax.sharding import PartitionSpec
+
+    from tf_yarn_tpu.parallel.mesh import AXIS_TP
+
+    if tp <= 1:
+        return PartitionSpec()
+    ax = _seq_axis(row_shape, max_seq_len)
+    if ax is None:
+        return PartitionSpec()
+    heads = ax + 1
+    if heads >= len(row_shape) or row_shape[heads] % tp:
+        return PartitionSpec()
+    spec = [None] * (len(row_shape) + 1)
+    spec[heads + 1] = AXIS_TP
+    return PartitionSpec(*spec)
 
 
 def _ceil_bucket(value: int, buckets: Tuple[int, ...]) -> Optional[int]:
@@ -748,10 +852,60 @@ class DecodeEngine:
         batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS,
         prompt_buckets: Tuple[int, ...] = DEFAULT_PROMPT_BUCKETS,
         token_bucket: int = DEFAULT_TOKEN_BUCKET,
+        mesh=None,
     ):
         if token_bucket < 1:
             raise ValueError(f"token_bucket must be >= 1, got {token_bucket}")
         self.model = model
+        # Tensor-parallel decode (docs/Serving.md): with a mesh, params
+        # place by the model's logical-axis annotations, slot KV shards
+        # its kv-heads axis over tp, and every compiled program lowers
+        # with explicit in/out shardings so XLA inserts the TP
+        # collectives — validated HERE, before any trace, so a bad tp
+        # config fails with a config error instead of a partitioner one.
+        self.mesh = mesh
+        self.tp_degree = 1
+        self._rep_sharding = None
+        self._param_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from tf_yarn_tpu.parallel import sharding as sharding_lib
+            from tf_yarn_tpu.parallel.mesh import AXIS_TP, mesh_axis_size
+
+            config = getattr(model, "config", None)
+            if config is None or not hasattr(config, "max_seq_len"):
+                raise ValueError(
+                    "DecodeEngine(mesh=...) needs a model with "
+                    "config.max_seq_len — the KV sharding rule anchors "
+                    "on the cache's sequence axis"
+                )
+            self.tp_degree = int(mesh_axis_size(mesh, AXIS_TP))
+            for name in ("n_heads", "n_kv_heads"):
+                value = getattr(config, name, None)
+                if value is not None and value % self.tp_degree:
+                    raise ValueError(
+                        f"model config {name}={value} does not divide "
+                        f"over tp={self.tp_degree} — tensor-parallel "
+                        "decode shards attention (and the KV cache) by "
+                        "heads; pick a tp that divides both head counts"
+                    )
+            self._rep_sharding = NamedSharding(mesh, PartitionSpec())
+            try:
+                abstract = jax.eval_shape(
+                    lambda r, t: model.init(r, t),
+                    jax.ShapeDtypeStruct((2,), jnp.uint32),
+                    jax.ShapeDtypeStruct((1, 8), jnp.int32),
+                )
+            except Exception as exc:
+                raise ValueError(
+                    "DecodeEngine(mesh=...) could not abstractly init "
+                    f"{type(model).__name__} to read its logical-axis "
+                    f"annotations: {type(exc).__name__}: {exc}"
+                ) from exc
+            self._param_shardings = sharding_lib.tree_shardings(
+                mesh, abstract
+            )
         self.batch_buckets = tuple(sorted(set(batch_buckets)))
         self.prompt_buckets = tuple(sorted(set(prompt_buckets)))
         self.token_bucket = int(token_bucket)
@@ -834,6 +988,86 @@ class DecodeEngine:
             (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
         )))
 
+    # -- tensor-parallel placement -----------------------------------------
+
+    def _place_params(self, params):
+        """Every public entry's param normalization: host arrays become
+        device arrays, and under a mesh every leaf lands on the
+        placement the model's logical-axis annotations assign (a no-op
+        transfer-wise once placed — sharded restores arrive here
+        already placed by inference.shard_restored_params)."""
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.mesh is None:
+            return params
+
+        def _place(leaf, sharding):
+            if getattr(leaf, "sharding", None) == sharding:
+                return leaf
+            return jax.device_put(leaf, sharding)
+
+        try:
+            return jax.tree_util.tree_map(
+                _place, params, self._param_shardings
+            )
+        except ValueError as exc:
+            raise ValueError(
+                "params do not match the model's init structure — "
+                f"cannot place them on the tp mesh: {exc}"
+            ) from exc
+
+    def _shardings_of(self, tree):
+        """The committed shardings of a concrete tree (the donated
+        grid/pool argument): used as the program's matching OUT
+        shardings so the donated buffer aliases instead of copying.
+        Host/numpy leaves read as replicated."""
+        return jax.tree_util.tree_map(
+            lambda leaf: (
+                leaf.sharding
+                if _is_named_sharding(getattr(leaf, "sharding", None))
+                else self._rep_sharding
+            ),
+            tree,
+        )
+
+    def _arg_shardings(self, args) -> tuple:
+        """Per-argument in_shardings for a sharded program lowering:
+        committed mesh placements pass through (params, the KV
+        grid/pool), everything else — the scheduler's per-tick numpy
+        tables/lengths/tokens/rngs/masks — is replicated."""
+        return tuple(self._shardings_of(arg) for arg in args)
+
+    def _jit(self, fn, args, donate=(), out_shardings=None):
+        """jax.jit wired for this engine's mesh: explicit in/out
+        shardings under tensor parallelism (XLA inserts the TP
+        collectives from these alone), the plain single-device jit
+        otherwise."""
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        kwargs: Dict[str, Any] = {
+            "donate_argnums": donate,
+            "in_shardings": self._arg_shardings(args),
+        }
+        if out_shardings is not None:
+            kwargs["out_shardings"] = out_shardings
+        return jax.jit(fn, **kwargs)
+
+    def _kv_shardings(self, avals):
+        """NamedSharding tree for a DENSE cache tree (row, grid, or
+        prefill output) under this engine's mesh: kv-heads axis over
+        tp (kv_partition_spec)."""
+        from jax.sharding import NamedSharding
+
+        max_seq_len = self.model.config.max_seq_len
+        return jax.tree_util.tree_map(
+            lambda aval: NamedSharding(
+                self.mesh,
+                kv_partition_spec(
+                    tuple(aval.shape), max_seq_len, self.tp_degree
+                ),
+            ),
+            avals,
+        )
+
     # -- compile cache -----------------------------------------------------
 
     def _compiled(self, cache_dict, key, stat_prefix, build):
@@ -875,9 +1109,25 @@ class DecodeEngine:
         prefill_key = (b, f, fp)
         prefill_fn = build_prefill_fn(self.model)
         prefill_args = (params, prompt)
+        def build():
+            out_shardings = None
+            if self.mesh is not None:
+                # Pin the fresh cache SHARDED at the source: everything
+                # downstream (insert_slot, pack_prefill) then propagates
+                # the placement instead of guessing it. The eval_shape
+                # runs only on a compile miss — not per admission.
+                cache_avals, _logits_aval = jax.eval_shape(
+                    prefill_fn, *prefill_args
+                )
+                out_shardings = (
+                    self._kv_shardings(cache_avals), self._rep_sharding,
+                )
+            return self._jit(
+                prefill_fn, prefill_args, out_shardings=out_shardings
+            ).lower(*prefill_args).compile()
+
         compiled = self._compiled(
-            self._prefill, prefill_key, "prefill",
-            lambda: jax.jit(prefill_fn).lower(*prefill_args).compile(),
+            self._prefill, prefill_key, "prefill", build,
         )
         # Dispatch-side span: async device futures, so this times the
         # enqueue (host cost), not the device compute — the XLA profiler
@@ -908,7 +1158,7 @@ class DecodeEngine:
     def prefill(self, params, prompt):
         """Public compiled prefill: [B, F] prompt -> (cache, last
         logits). B/F key the compile cache directly."""
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         prompt = jnp.asarray(prompt, jnp.int32)
         return self._compiled_prefill(
             params, prompt, self._params_fingerprint(params)
@@ -921,15 +1171,33 @@ class DecodeEngine:
         nothing runs on the device except the zeros allocation."""
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         cache_avals = jax.eval_shape(
             build_prefill_fn(self.model), params,
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         )[0]
-        return jax.tree_util.tree_map(
-            lambda leaf: jnp.zeros((max_slots,) + leaf.shape, leaf.dtype),
+
+        def build():
+            return jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros(
+                    (max_slots,) + leaf.shape, leaf.dtype
+                ),
+                cache_avals,
+            )
+
+        if self.mesh is None:
+            return build()
+        # Sharded zeros straight onto the mesh — each device allocates
+        # only its 1/tp shard, no full-grid staging anywhere.
+        grid_avals = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (max_slots,) + leaf.shape, leaf.dtype
+            ),
             cache_avals,
         )
+        return jax.jit(
+            build, out_shardings=self._kv_shardings(grid_avals)
+        )()
 
     def insert_slot(self, slot_cache, slot: int, row_cache):
         """Splice a freshly prefilled batch-1 cache (cache_index
@@ -961,7 +1229,7 @@ class DecodeEngine:
         program (build_step_fn). Compiled once per (grid size, sampling
         config, params fingerprint); the KV grid and the per-slot rng
         buffer are donated. Returns (slot_cache, emitted [S], rngs)."""
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         tokens = jnp.asarray(tokens, jnp.int32)
         rngs = jnp.asarray(rngs, jnp.uint32)
         sample_mask = jnp.asarray(sample_mask, bool)
@@ -970,10 +1238,18 @@ class DecodeEngine:
         step_key = (slots, float(temperature), top_k, top_p, fp)
         step_fn = build_step_fn(self.model, temperature, top_k, top_p)
         step_args = (params, slot_cache, tokens, rngs, sample_mask)
+        out_shardings = None
+        if self.mesh is not None:
+            out_shardings = (
+                self._shardings_of(slot_cache), self._rep_sharding,
+                self._rep_sharding,
+            )
         compiled = self._compiled(
             self._step, step_key, "step",
-            lambda: jax.jit(step_fn, donate_argnums=(1, 3))
-            .lower(*step_args).compile(),
+            lambda: self._jit(
+                step_fn, step_args, donate=(1, 3),
+                out_shardings=out_shardings,
+            ).lower(*step_args).compile(),
         )
         with telemetry.span("decode_engine/step", slots=slots):
             return compiled(*step_args)
@@ -998,7 +1274,7 @@ class DecodeEngine:
         drafts changing every tick never recompiles. The KV grid and the
         rng buffer are donated. Returns (slot_cache, emitted [S, W],
         counts [S], rngs)."""
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         tokens = jnp.asarray(tokens, jnp.int32)
         n_known = jnp.asarray(n_known, jnp.int32)
         eos_ids = jnp.asarray(eos_ids, jnp.int32)
@@ -1009,10 +1285,17 @@ class DecodeEngine:
         key = ("spec", slots, width, float(temperature), top_k, top_p, fp)
         fn = build_spec_step_fn(self.model, width, temperature, top_k, top_p)
         args = (params, slot_cache, tokens, n_known, eos_ids, rngs, active)
+        out_shardings = None
+        if self.mesh is not None:
+            out_shardings = (
+                self._shardings_of(slot_cache), self._rep_sharding,
+                self._rep_sharding, self._rep_sharding,
+            )
         compiled = self._compiled(
             self._spec_step, key, "spec_step",
-            lambda: jax.jit(fn, donate_argnums=(1, 5))
-            .lower(*args).compile(),
+            lambda: self._jit(
+                fn, args, donate=(1, 5), out_shardings=out_shardings,
+            ).lower(*args).compile(),
         )
         with telemetry.span("decode_engine/spec_step", slots=slots,
                             width=width):
@@ -1038,16 +1321,41 @@ class DecodeEngine:
                 f"num_blocks must be >= 2 (block 0 is reserved), "
                 f"got {num_blocks}"
             )
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
+        row_avals = _decode_cache_aval(self.model, params)
         avals = paged_pool_avals(
-            _decode_cache_aval(self.model, params), num_blocks, block_size,
+            row_avals, num_blocks, block_size,
             self.model.config.max_seq_len,
         )
-        return jax.tree_util.tree_map(
-            lambda aval: (None if aval is None
-                          else jnp.zeros(aval.shape, aval.dtype)),
-            avals, is_leaf=_is_none,
+
+        def build():
+            return jax.tree_util.tree_map(
+                lambda aval: (None if aval is None
+                              else jnp.zeros(aval.shape, aval.dtype)),
+                avals, is_leaf=_is_none,
+            )
+
+        if self.mesh is None:
+            return build()
+        # Sharded pool: every block's kv-heads axis splits over tp, so
+        # each device holds 1/tp of EVERY block (pool_partition_spec —
+        # the pool shape itself cannot anchor on max_seq_len, the row
+        # aval supplies the axis).
+        from jax.sharding import NamedSharding
+
+        max_seq_len = self.model.config.max_seq_len
+        shardings = jax.tree_util.tree_map(
+            lambda aval, row: (
+                None if aval is None else NamedSharding(
+                    self.mesh,
+                    pool_partition_spec(
+                        tuple(row.shape), max_seq_len, self.tp_degree
+                    ),
+                )
+            ),
+            avals, row_avals, is_leaf=_is_none,
         )
+        return jax.jit(build, out_shardings=shardings)()
 
     def max_blocks_per_slot(self, block_size: int) -> int:
         """Block-table width: a slot grown to max_seq_len holds exactly
@@ -1077,10 +1385,13 @@ class DecodeEngine:
                self._tree_fingerprint(pool))
         pack_fn = build_pack_prefill_fn(self.model, block_size, prefill_len)
         args = (pool, block_ids, row_cache)
+        out_shardings = self._shardings_of(pool) if self.mesh is not None \
+            else None
         compiled = self._compiled(
             self._pack, key, "pack",
-            lambda: jax.jit(pack_fn, donate_argnums=(0,))
-            .lower(*args).compile(),
+            lambda: self._jit(
+                pack_fn, args, donate=(0,), out_shardings=out_shardings,
+            ).lower(*args).compile(),
         )
         with telemetry.span("decode_engine/pack_prefill",
                             prefill=prefill_len):
@@ -1106,7 +1417,7 @@ class DecodeEngine:
         fingerprint); tables/lengths/tokens are traced, so per-tick
         table changes never recompile. The pool and the rng buffer are
         donated. Returns (pool, emitted [S], rngs)."""
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         tables = jnp.asarray(tables, jnp.int32)
         lengths = jnp.asarray(lengths, jnp.int32)
         tokens = jnp.asarray(tokens, jnp.int32)
@@ -1120,10 +1431,17 @@ class DecodeEngine:
             self.model, block_size, temperature, top_k, top_p
         )
         args = (params, pool, tables, lengths, tokens, rngs, sample_mask)
+        out_shardings = None
+        if self.mesh is not None:
+            out_shardings = (
+                self._shardings_of(pool), self._rep_sharding,
+                self._rep_sharding,
+            )
         compiled = self._compiled(
             self._paged_step, key, "paged_step",
-            lambda: jax.jit(step_fn, donate_argnums=(1, 5))
-            .lower(*args).compile(),
+            lambda: self._jit(
+                step_fn, args, donate=(1, 5), out_shardings=out_shardings,
+            ).lower(*args).compile(),
         )
         with telemetry.span("decode_engine/paged_step", slots=slots):
             return compiled(*args)
@@ -1152,7 +1470,16 @@ class DecodeEngine:
         n_known / eos_ids are traced — per-tick changes never recompile.
         The pool and the rng buffer are donated. Returns (pool, emitted
         [S, W], counts [S], rngs)."""
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if decode_attention == "fused" and self.tp_degree > 1:
+            raise ValueError(
+                "decode_attention='fused' cannot run tensor-parallel "
+                "yet: paged_int8_window_attention reads the whole block "
+                "pool inside one pallas kernel and cannot read a "
+                f"tp={self.tp_degree}-sharded pool; use "
+                "decode_attention='gather' (XLA shards the gather "
+                "path), or tp=1"
+            )
+        params = self._place_params(params)
         tables = jnp.asarray(tables, jnp.int32)
         lengths = jnp.asarray(lengths, jnp.int32)
         tokens = jnp.asarray(tokens, jnp.int32)
@@ -1171,10 +1498,17 @@ class DecodeEngine:
         )
         args = (params, pool, tables, lengths, tokens, n_known, eos_ids,
                 rngs, active)
+        out_shardings = None
+        if self.mesh is not None:
+            out_shardings = (
+                self._shardings_of(pool), self._rep_sharding,
+                self._rep_sharding, self._rep_sharding,
+            )
         compiled = self._compiled(
             self._paged_spec_step, key, "paged_spec_step",
-            lambda: jax.jit(fn, donate_argnums=(1, 7))
-            .lower(*args).compile(),
+            lambda: self._jit(
+                fn, args, donate=(1, 7), out_shardings=out_shardings,
+            ).lower(*args).compile(),
         )
         with telemetry.span("decode_engine/paged_spec_step", slots=slots,
                             width=width):
@@ -1239,7 +1573,7 @@ class DecodeEngine:
                 for i in range(0, b, max_batch)
             ]
             return jnp.concatenate(chunks, axis=0)
-        params = jax.tree_util.tree_map(jnp.asarray, params)
+        params = self._place_params(params)
         fp = self._params_fingerprint(params)
         with self._lock:
             self.stats["calls"] += 1
@@ -1301,10 +1635,17 @@ class DecodeEngine:
         decode_fn = build_decode_fn(
             self.model, temperature, top_k, top_p, has_eos, has_rest
         )
+        decode_out_shardings = None
+        if self.mesh is not None:
+            decode_out_shardings = (
+                self._rep_sharding, self._shardings_of(cache),
+            )
         compiled_decode = self._compiled(
             self._decode, decode_key, "decode",
-            lambda: jax.jit(decode_fn, donate_argnums=donate)
-            .lower(*decode_args).compile(),
+            lambda: self._jit(
+                decode_fn, decode_args, donate=donate,
+                out_shardings=decode_out_shardings,
+            ).lower(*decode_args).compile(),
         )
         # The returned final cache exists only to give the donated input
         # cache an output to alias; dropping it frees the HBM.
@@ -1324,19 +1665,21 @@ _ENGINES: Dict[Any, DecodeEngine] = {}
 _ENGINES_LOCK = threading.Lock()
 
 
-def get_engine(model) -> DecodeEngine:
+def get_engine(model, mesh=None) -> DecodeEngine:
     """The shared engine for `model` (flax modules hash by structure, so
     equal configs share one engine; unhashable models fall back to
-    identity)."""
+    identity). `mesh` keys the registry too — a tensor-parallel engine
+    and a single-device engine for the same model are distinct programs
+    and must not share compile caches."""
     try:
-        key = model
+        key = (model, mesh)
         hash(key)
     except TypeError:
-        key = id(model)
+        key = (id(model), mesh)
     with _ENGINES_LOCK:
         engine = _ENGINES.get(key)
         if engine is None:
-            engine = _ENGINES[key] = DecodeEngine(model)
+            engine = _ENGINES[key] = DecodeEngine(model, mesh=mesh)
         return engine
 
 

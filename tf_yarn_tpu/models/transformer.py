@@ -210,12 +210,20 @@ class Attention(nn.Module):
             # block pool (kv_pool collection) + per-slot (tables,
             # lengths). Rows are the batch's slots, the s axis the
             # speculative window; no dense cache variables exist on
-            # this path at all.
+            # this path at all. NOT partitionable: the pallas kernel
+            # reads the whole pool, so tensor-parallel serving refuses
+            # this path at build (DecodeEngine.paged_spec_step).
             out = self._fused_paged_decode(q, k, v, paged_ctx)
         elif decode:
             # KV cache for autoregressive decoding: append this call's
             # keys/values at cache_index, attend against the whole cache
-            # (future slots masked by the offset causal mask).
+            # (future slots masked by the offset causal mask). Under
+            # tensor-parallel serving the engine shards these cache
+            # variables' kv-heads axis over `tp` (decode_engine.
+            # kv_partition_spec) while wq/wo place by their HEADS
+            # annotations — this body needs no sharding awareness: XLA
+            # derives the per-device attention and inserts the wo/
+            # w_down all-reduces from the placements alone.
             if cfg.kv_cache_dtype not in ("bf16", "int8"):
                 raise ValueError(
                     f"kv_cache_dtype={cfg.kv_cache_dtype!r}: expected "

@@ -252,6 +252,13 @@ def build_mesh(
     return Mesh(mesh_devices, spec.axis_names)
 
 
+def mesh_axis_size(mesh, axis: str) -> int:
+    """Size of one named axis in a built Mesh (1 when the axis is absent
+    or disabled) — the tp-degree lookup serving's sharded decode engine
+    and its telemetry share."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+
+
 def batch_sharding(mesh, extra_batch_dims: int = 0):
     """NamedSharding for a [global_batch, ...] input: batch over dp+fsdp,
     remaining dims replicated (sequence sharding is applied inside models

@@ -171,6 +171,37 @@ def reshard_state(state, new_mesh: Mesh, old_spec=None, shardings=None):
     )
 
 
+def shard_like_annotated(mesh: Mesh, abstract_tree, tree):
+    """Place an UNBOXED pytree (a restored checkpoint) onto `mesh` with
+    the placements the ANNOTATED abstract tree assigns through
+    LOGICAL_RULES — the restore-side twin of `tree_shardings`.
+
+    By restore time the flax Partitioned boxes are gone from the values
+    (checkpoints store raw arrays), so the logical names must come from
+    an abstract re-init (`jax.eval_shape` of ``model.init``, boxes
+    intact). Recomputing placements from the unboxed values would fall
+    back to FSDP inference and put annotated params somewhere else than
+    the compiled programs expect — the same pitfall `reshard_state`
+    documents. Leaves already holding their target sharding are left
+    untouched (no transfer on a re-place)."""
+    shardings = tree_shardings(mesh, abstract_tree)
+    value_def = jax.tree_util.tree_structure(tree)
+    sharding_def = jax.tree_util.tree_structure(shardings)
+    if value_def != sharding_def:
+        raise ValueError(
+            "restored tree does not match the model's init structure — "
+            "cannot map logical-axis placements onto it "
+            f"(restored: {value_def}, init: {sharding_def})"
+        )
+
+    def _place(leaf, sharding):
+        if getattr(leaf, "sharding", None) == sharding:
+            return leaf
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(_place, tree, shardings)
+
+
 def unbox_params(tree):
     """Strip flax Partitioned boxes, leaving raw arrays (used after placement
     decisions are extracted, so apply() sees plain params).

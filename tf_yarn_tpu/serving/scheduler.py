@@ -168,6 +168,18 @@ class SlotScheduler:
                 "decode_attention='fused' streams the paged block pool "
                 "directly; it requires kv_layout='paged'"
             )
+        # Tensor-parallel decode rides entirely inside the engine's
+        # compiled programs — the scheduler's tick logic is unchanged —
+        # but the one composition that CANNOT shard fails here, loudly,
+        # before any pool is allocated.
+        self.tp_degree = int(getattr(engine, "tp_degree", 1) or 1)
+        if decode_attention == "fused" and self.tp_degree > 1:
+            raise ValueError(
+                "decode_attention='fused' cannot run tensor-parallel "
+                f"(engine tp={self.tp_degree}): the paged-int8 pallas "
+                "kernel cannot read a sharded block pool yet; use "
+                "decode_attention='gather' or tp=1"
+            )
         self.engine = engine
         self.params = params
         self.max_slots = max_slots
@@ -246,9 +258,19 @@ class SlotScheduler:
             self._prefix = None
             kv_bytes = _cache_nbytes(self._cache)
         self._kv_bytes = kv_bytes
+        # Per-DEVICE residency: under tp sharding each device holds 1/tp
+        # of every slot's KV (global bytes above are unchanged) — the
+        # capacity-per-chip number the HBM planning reads.
+        self._kv_bytes_per_device = _cache_nbytes_per_device(
+            self._pool if kv_layout == "paged" else self._cache
+        ) or kv_bytes
         self._registry.gauge(
             "serving/kv_cache_hbm_bytes", layout=kv_layout
         ).set(kv_bytes)
+        self._registry.gauge(
+            "serving/kv_cache_hbm_bytes_per_device", layout=kv_layout
+        ).set(self._kv_bytes_per_device)
+        self._registry.gauge("serving/tp_degree").set(self.tp_degree)
 
     # -- submission (any thread) -------------------------------------------
 
@@ -788,6 +810,8 @@ class SlotScheduler:
             "top_p": self.top_p,
             "kv_layout": self.kv_layout,
             "kv_cache_hbm_bytes": self._kv_bytes,
+            "kv_cache_hbm_bytes_per_device": self._kv_bytes_per_device,
+            "tp_degree": self.tp_degree,
             "draining": self._draining,
             "spec_k": self.spec_k,
             "decode_attention": self.decode_attention,
@@ -827,6 +851,17 @@ def _cache_nbytes(tree) -> int:
         from tf_yarn_tpu.models.decode_engine import cache_nbytes
 
         return cache_nbytes(tree)
+    except Exception:
+        return 0
+
+
+def _cache_nbytes_per_device(tree) -> int:
+    """Per-device resident bytes (sharded leaves count one shard); same
+    fake-engine tolerance as `_cache_nbytes`."""
+    try:
+        from tf_yarn_tpu.models.decode_engine import tree_nbytes_per_device
+
+        return tree_nbytes_per_device(tree)
     except Exception:
         return 0
 

@@ -267,11 +267,36 @@ def run_serving(experiment, runtime=None) -> dict:
         )
     telemetry.enable_env_jsonl(telemetry_task)
     fs_lib.check_model_dir_placement(experiment.model_dir)
+    # Tensor-parallel decode: build the replica's mesh BEFORE the
+    # restore, so a device shortfall fails in milliseconds ("need N
+    # devices, have M"), not after minutes of weight loading.
+    mesh = None
+    mesh_spec = getattr(experiment, "mesh_spec", None)
+    if mesh_spec is not None and mesh_spec.total_devices > 1:
+        from tf_yarn_tpu.parallel import mesh as mesh_lib
+
+        with telemetry.span("serving/build_mesh",
+                            devices=mesh_spec.total_devices):
+            mesh = mesh_lib.build_mesh(
+                mesh_spec,
+                mesh_lib.select_devices(mesh_spec.total_devices),
+            )
+        _logger.info(
+            "serving tensor-parallel: tp=%d over %d devices",
+            mesh_spec.tp, mesh_spec.total_devices,
+        )
     with telemetry.span("serving/restore_params"):
         variables, step = inference._restore_params(
             experiment.model_dir, experiment.step
         )
-    engine = get_engine(experiment.model)
+    if mesh is not None:
+        # The sharded restore path: logical-axis placements recovered
+        # from an abstract re-init, one device_put per leaf.
+        with telemetry.span("serving/shard_params"):
+            variables = inference.shard_restored_params(
+                experiment.model, variables, mesh
+            )
+    engine = get_engine(experiment.model, mesh=mesh)
     scheduler = SlotScheduler(
         engine,
         variables,

@@ -13,6 +13,14 @@ SIGTERM (the TPU-VM preemption notice) sets the drain flag
 `run_serving` polls: the frontend stops accepting, in-flight responses
 finish as ``shutdown``, and the task exits cleanly instead of dying
 mid-chunk.
+
+A ``ServingExperiment(mesh_spec=MeshSpec(tp=N))`` makes this task a
+TENSOR-PARALLEL replica (docs/Serving.md "Tensor-parallel decode"):
+`run_serving` builds the mesh over the task's N devices BEFORE the
+restore — a device shortfall fails the attempt in milliseconds with
+"need N devices, have M", classified and retried like any other
+failure — then shards the restored weights and the slot KV across it.
+The fleet router fronts sharded replicas unchanged.
 """
 
 from __future__ import annotations

@@ -1,0 +1,34 @@
+"""Seeded TYA201: a wrong PartitionSpec forces an all-gather.
+
+The input is sharded over tp but the output is declared replicated —
+the partitioner must re-materialize the full array on every device,
+exactly the silent multi-gather a placement typo inserts. The entry's
+manifest declares NO collectives, so the census flags it.
+"""
+
+from tf_yarn_tpu.analysis.hlo_engine import HloEntry, Manifest
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    sharded = NamedSharding(mesh, PartitionSpec("tp", None))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    fn = jax.jit(
+        lambda x: x * 2.0, in_shardings=(sharded,),
+        out_shardings=replicated,
+    )
+    return fn, (jax.ShapeDtypeStruct((8, 64), jnp.float32),), {}
+
+
+ENTRIES = [
+    HloEntry(
+        "fixture.tya201.forced_all_gather", _build,
+        manifest=Manifest(collectives={}),
+        requires=("multi_device",),
+    ),
+]

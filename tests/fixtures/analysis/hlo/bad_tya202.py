@@ -1,0 +1,31 @@
+"""Seeded TYA202: a dropped donation.
+
+The manifest declares arg 0 (the cache) donated — mirroring what the
+serving engine promises — but the jit carries no donate_argnums, so the
+compiled artifact has no input_output_alias and the cache
+double-buffers in HBM.
+"""
+
+from tf_yarn_tpu.analysis.hlo_engine import HloEntry, Manifest
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(  # donation dropped: no donate_argnums
+        lambda cache, token: (cache.at[0].set(token), token + 1)
+    )
+    args = (
+        jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    return fn, args, {}
+
+
+ENTRIES = [
+    HloEntry(
+        "fixture.tya202.dropped_donation", _build,
+        manifest=Manifest(collectives={}, donate_argnums=(0,)),
+    ),
+]

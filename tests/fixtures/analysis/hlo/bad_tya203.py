@@ -1,0 +1,33 @@
+"""Seeded TYA203: a host callback in the compiled artifact.
+
+`pure_callback` survives lowering as a host custom-call
+(`xla_python_cpu_callback` / FFI variants) — one device<->host
+round-trip per execution, invisible to source lints and deliberately
+tolerated by jaxpr-level `allow=`s in some entries; the HLO engine is
+the layer that must always see it.
+"""
+
+from tf_yarn_tpu.analysis.hlo_engine import HloEntry, Manifest
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        y = jax.pure_callback(
+            lambda v: v,
+            jax.ShapeDtypeStruct((4,), jnp.float32),
+            x,
+        )
+        return y * 2.0
+
+    return fn, (jax.ShapeDtypeStruct((4,), jnp.float32),), {}
+
+
+ENTRIES = [
+    HloEntry(
+        "fixture.tya203.host_callback", _build,
+        manifest=Manifest(collectives={}),
+    ),
+]

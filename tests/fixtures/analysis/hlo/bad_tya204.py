@@ -1,0 +1,39 @@
+"""Seeded TYA204: an oversized fully-replicated operand.
+
+The 1 MiB weight is placed replicated on a 2-device mesh while the
+manifest budgets 64 KiB of replication — size x n_devices of HBM for
+an operand the sharding rules were supposed to split.
+"""
+
+from tf_yarn_tpu.analysis.hlo_engine import HloEntry, Manifest
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    replicated = NamedSharding(mesh, PartitionSpec())
+    fn = jax.jit(
+        lambda w, x: x @ w,
+        in_shardings=(replicated, replicated),
+        out_shardings=replicated,
+    )
+    args = (
+        jax.ShapeDtypeStruct((512, 512), jnp.float32),  # 1 MiB, replicated
+        jax.ShapeDtypeStruct((8, 512), jnp.float32),
+    )
+    return fn, args, {}
+
+
+ENTRIES = [
+    HloEntry(
+        "fixture.tya204.replicated_weight", _build,
+        manifest=Manifest(
+            collectives={}, max_replicated_bytes=64 * 1024
+        ),
+        requires=("multi_device",),
+    ),
+]

@@ -1,0 +1,26 @@
+"""Seeded TYA205: recompile churn.
+
+A driver whose program-cache registry shows three distinct compile keys
+for the `step` kind against a budget of one — the signature of a tick
+input (tokens/tables/lengths) leaking into the cache key instead of
+being traced, i.e. serving recompiling mid-flight.
+"""
+
+from tf_yarn_tpu.analysis.hlo_engine import ChurnEntry
+
+
+def _build():
+    def drive():
+        # What DecodeEngine.program_keys() would return after three
+        # ticks if the token value were (wrongly) part of the key.
+        return {"step": [("g", 3), ("g", 4), ("g", 5)], "paged_step": [("p",)]}
+
+    return drive
+
+
+CHURN = [
+    ChurnEntry(
+        "fixture.tya205.churny_cache", _build,
+        expected={"step": 1, "paged_step": 1},
+    ),
+]

@@ -1,0 +1,44 @@
+"""False-positive guard for the HLO engine: a donated, collective-free,
+callback-free entry and a stable-key churn driver — none of TYA201–205
+may fire on this module."""
+
+from tf_yarn_tpu.analysis.hlo_engine import ChurnEntry, HloEntry, Manifest
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(cache, token):
+        return cache.at[0].set(token), token + 1.0
+
+    args = (
+        jax.ShapeDtypeStruct((16, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    return fn, args, {}
+
+
+def _build_churn():
+    def drive():
+        return {"step": [("g", 2)], "paged_step": [("p", 2)]}
+
+    return drive
+
+
+ENTRIES = [
+    HloEntry(
+        "fixture.clean.donated_step", _build,
+        manifest=Manifest(
+            collectives={}, donate_argnums=(0,),
+            max_replicated_bytes=1 << 20,
+        ),
+    ),
+]
+
+CHURN = [
+    ChurnEntry(
+        "fixture.clean.stable_keys", _build_churn,
+        expected={"step": 1, "paged_step": 1},
+    ),
+]

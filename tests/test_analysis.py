@@ -1,9 +1,11 @@
 """The static checker checks itself: every rule flags its bad fixture,
 the clean fixture stays clean (false-positive guard), the repo passes
 its own checker (the CI gate — any future PR introducing a flagged
-pattern fails here), and the jaxpr engine verifies the collectives
-wrappers' axis discipline."""
+pattern fails here), the jaxpr engine verifies the collectives
+wrappers' axis discipline, and the HLO engine detects every seeded
+TYA201–205 violation in its compiled-artifact fixtures."""
 
+import importlib.util
 import os
 import subprocess
 import sys
@@ -19,8 +21,10 @@ from tf_yarn_tpu.analysis.rules import RULES
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+HLO_FIXTURES = os.path.join(FIXTURES, "hlo")
 
 AST_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "ast")
+HLO_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "hlo")
 
 
 # --- AST engine: each rule fires on its fixture, and only its rule -------
@@ -96,10 +100,36 @@ def _run_checker(*args):
 
 
 def test_repo_passes_its_own_checker():
-    proc = _run_checker("tf_yarn_tpu")
+    """THE analysis gate: one invocation runs AST + jaxpr + HLO over the
+    repo, and the per-engine wall time lands in the tier-1 log so a
+    creeping analysis budget is visible, not just felt."""
+    import json
+
+    proc = _run_checker("tf_yarn_tpu", "--json")
     assert proc.returncode == 0, (
-        "the checker found problems in tf_yarn_tpu/ — fix them or "
-        f"suppress with # noqa: TYA0xx:\n{proc.stdout}\n{proc.stderr}"
+        "the checker found problems in tf_yarn_tpu/ — fix them, "
+        "suppress with # noqa: TYA0xx / entry allow=, or re-baseline "
+        f"hlo_budgets.json:\n{proc.stdout}\n{proc.stderr}"
+    )
+    payload = json.loads(proc.stdout)
+    assert payload["json_schema_version"] == 2
+    seconds = payload["engine_seconds"]
+    assert set(seconds) == {"ast", "jaxpr", "hlo"}
+    print(
+        "analysis engine seconds: "
+        + " ".join(f"{k}={v}" for k, v in sorted(seconds.items()))
+    )
+    # The headline manifest ran (8 CPU devices are forced in this env):
+    # sharded_step's census is present, with its exact all-reduce count
+    # and zero above-floor all-gathers baked into the manifest check.
+    census = payload["hlo_census"]
+    assert "models.decode_engine.sharded_step" in census
+    assert (
+        census["models.decode_engine.sharded_step"]["collectives"][
+            "all-reduce"]["count"] == 3
+    )
+    assert "all-gather" not in (
+        census["models.decode_engine.sharded_step"]["collectives"]
     )
 
 
@@ -136,8 +166,8 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
 
 
 def test_fixtures_fail_the_checker():
-    proc = _run_checker(FIXTURES, "--no-jaxpr")
-    assert proc.returncode == 1, proc.stdout + proc.stderr
+    proc = _run_checker(FIXTURES, "--no-jaxpr", "--no-hlo")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
     # every AST rule shows up in the aggregate run
     for code in AST_RULES:
         assert code in proc.stdout, f"{code} missing from:\n{proc.stdout}"
@@ -146,11 +176,29 @@ def test_fixtures_fail_the_checker():
 def test_checker_json_output():
     import json
 
-    proc = _run_checker(FIXTURES, "--no-jaxpr", "--json")
-    assert proc.returncode == 1
+    proc = _run_checker(FIXTURES, "--no-jaxpr", "--no-hlo", "--json")
+    assert proc.returncode == 2
     payload = json.loads(proc.stdout)
+    assert payload["json_schema_version"] == 2
     assert payload["n_findings"] == len(payload["findings"]) > 0
     assert {f["code"] for f in payload["findings"]} >= set(AST_RULES)
+    # suppressed findings surface as notices, never silently vanish
+    assert "suppressed_findings" in payload
+
+
+def test_checker_exit_codes_distinguish_findings_from_errors():
+    """0 clean / 2 findings / 1 engine or usage error — CI can tell
+    'the code has defects' from 'the checker itself broke'."""
+    # findings -> 2 (asserted above on the fixtures); usage error -> 1
+    proc = _run_checker("--definitely-not-a-flag")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    # engine error (nonexistent path) -> 1, not 2
+    proc = _run_checker("no/such/path_anywhere", "--no-jaxpr", "--no-hlo")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "error" in proc.stderr.lower()
+    # --help is not an error
+    proc = _run_checker("--help")
+    assert proc.returncode == 0
 
 
 # --- jaxpr engine ---------------------------------------------------------
@@ -158,9 +206,10 @@ def test_checker_json_output():
 def test_jaxpr_engine_collectives_verify_clean():
     from tf_yarn_tpu.analysis.jaxpr_engine import _collective_entries, run
 
-    findings, counts, skipped = run(_collective_entries())
+    findings, counts, skipped, suppressed = run(_collective_entries())
     assert findings == [], [f.format() for f in findings]
     assert skipped == []
+    assert suppressed == []
     assert counts["parallel.collectives.all_reduce_sum"]["psum"] == 1
     assert counts["parallel.collectives.ring_shift"]["ppermute"] == 1
     assert counts["parallel.collectives.all_gather"]["all_gather"] == 1
@@ -230,7 +279,7 @@ def test_jaxpr_engine_flags_host_callback_in_hot_path():
 def test_jaxpr_engine_default_entries_clean_on_this_build():
     from tf_yarn_tpu.analysis.jaxpr_engine import run
 
-    findings, counts, skipped = run()
+    findings, counts, skipped, _suppressed = run()
     assert findings == [], [f.format() for f in findings]
     # the flagship model traced: lowering regressions show as count diffs
     assert "models.transformer.fwd_bwd" in counts
@@ -270,7 +319,184 @@ def test_jaxpr_engine_default_entries_clean_on_this_build():
     assert fused.get("scatter", 0) > 0
 
 
+def test_jaxpr_engine_allow_suppresses_and_surfaces():
+    """The jaxpr/HLO twin of `# noqa`: an entry-level allow= keeps the
+    finding out of failures but surfaces it as a notice."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.analysis.jaxpr_engine import EntryPoint, run
+
+    def build():
+        def chatty(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        return chatty, (jax.ShapeDtypeStruct((4,), jnp.float32),), {}
+
+    entry = EntryPoint("test.allowed_chatty", build, allow=("TYA103",))
+    findings, _counts, _skipped, suppressed = run([entry])
+    assert findings == [], [f.format() for f in findings]
+    assert [f.code for f in suppressed] == ["TYA103"]
+
+
 def test_finding_format_and_json_roundtrip():
     finding = Finding("TYA006", "msg", "a/b.py", 3, 7)
     assert finding.format() == "a/b.py:3:7: TYA006 msg"
     assert finding.to_json()["line"] == 3
+
+
+# --- HLO engine: compiled-artifact audits ---------------------------------
+
+def _load_hlo_fixture(name):
+    path = os.path.join(HLO_FIXTURES, f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"hlo_fixture_{name}", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _run_hlo_fixture(module, **overrides):
+    from tf_yarn_tpu.analysis import hlo_engine
+
+    return hlo_engine.run(
+        entries=overrides.get("entries", getattr(module, "ENTRIES", [])),
+        churn_entries=getattr(module, "CHURN", []),
+        budget_path=None,  # fixtures have no baseline; manifests only
+    )
+
+
+@pytest.mark.parametrize("code", ["TYA201", "TYA202", "TYA203", "TYA204",
+                                  "TYA205"])
+def test_hlo_bad_fixture_flags_exactly_its_rule(code):
+    report = _run_hlo_fixture(_load_hlo_fixture(f"bad_{code.lower()}"))
+    assert report.skipped == [], report.skipped
+    codes = {f.code for f in report.findings}
+    assert codes == {code}, (
+        f"expected only {code}, got {sorted(codes)}: "
+        f"{[f.format() for f in report.findings]}"
+    )
+
+
+def test_hlo_clean_fixture_has_no_findings():
+    report = _run_hlo_fixture(_load_hlo_fixture("clean"))
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.suppressed == []
+    # the clean entry's donation really aliased (the check has teeth)
+    assert report.census["fixture.clean.donated_step"]["aliased_params"] > 0
+
+
+def test_every_hlo_rule_has_a_fixture():
+    for code in HLO_RULES:
+        assert os.path.exists(
+            os.path.join(HLO_FIXTURES, f"bad_{code.lower()}.py")
+        ), f"no fixture for {code}"
+
+
+def test_hlo_entry_allow_suppresses_and_surfaces():
+    import dataclasses
+
+    module = _load_hlo_fixture("bad_tya203")
+    allowed = [
+        dataclasses.replace(entry, allow=("TYA203",))
+        for entry in module.ENTRIES
+    ]
+    report = _run_hlo_fixture(module, entries=allowed)
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert [f.code for f in report.suppressed] == ["TYA203"]
+
+
+def test_hlo_collective_census_parser():
+    from tf_yarn_tpu.analysis.hlo_engine import collective_census
+
+    text = (
+        "  %ar = f32[2,64]{1,0} all-reduce(%x), replica_groups={{0,1}}\n"
+        "  %ag = f32[4]{0} all-gather(%y), dimensions={0}\n"
+        "  %ars = (f32[8,8]{1,0}, f32[8,8]{1,0}) all-reduce-start(%a, %b)\n"
+        "  %ard = f32[8,8]{1,0} all-reduce-done(%ars)\n"
+    )
+    big, small = collective_census(text, small_floor_bytes=64)
+    assert big["all-reduce"]["count"] == 2  # plain + -start; -done skipped
+    assert big["all-reduce"]["bytes"] == 2 * 64 * 4 + 2 * 8 * 8 * 4
+    assert small == {"all-gather": 1}  # 16B, below the floor
+
+
+def test_hlo_alias_parser():
+    from tf_yarn_tpu.analysis.hlo_engine import aliased_params
+
+    text = (
+        "HloModule jit_step, input_output_alias={ {0}: (1, {}, may-alias),"
+        " {2}: (3, {}, must-alias) }, entry_computation_layout=...\n"
+        "  %body = ...\n"
+    )
+    assert aliased_params(text) == frozenset({1, 3})
+    assert aliased_params("HloModule jit_f, entry...\n") == frozenset()
+
+
+def test_hlo_budget_diff_detects_regression(tmp_path):
+    from pathlib import Path
+
+    from tf_yarn_tpu.analysis.hlo_engine import (
+        diff_budget,
+        load_budget,
+        write_budget,
+    )
+
+    path = Path(tmp_path) / "budgets.json"
+    baseline_census = {
+        "entry.a": {
+            "collectives": {"all-reduce": {"count": 3, "bytes": 1536}},
+            "small_collectives": {}, "custom_calls": {},
+            "aliased_params": 4,
+        },
+    }
+    write_budget(baseline_census, path)
+    budget = load_budget(path)
+    # identical census: clean
+    assert diff_budget(baseline_census, budget, path) == []
+    # a fourth all-reduce appears: TYA201
+    drifted = {
+        "entry.a": {
+            **baseline_census["entry.a"],
+            "collectives": {"all-reduce": {"count": 4, "bytes": 2048}},
+        },
+    }
+    codes = [f.code for f in diff_budget(drifted, budget, path)]
+    assert codes == ["TYA201"]
+    # a donation alias disappears: TYA202
+    dropped = {
+        "entry.a": {**baseline_census["entry.a"], "aliased_params": 0},
+    }
+    codes = [f.code for f in diff_budget(dropped, budget, path)]
+    assert codes == ["TYA202"]
+    # an entry with no baseline at all is itself a finding
+    codes = [
+        f.code
+        for f in diff_budget({"entry.new": {}}, budget, path)
+    ]
+    assert codes == ["TYA201"]
+    # and a missing budget file fails loudly, not silently
+    missing = [f.code for f in diff_budget({}, None, path)]
+    assert missing == ["TYA201"]
+
+
+def test_hlo_budget_file_is_checked_in_and_current_schema():
+    from tf_yarn_tpu.analysis.hlo_engine import (
+        DEFAULT_BUDGET_PATH,
+        load_budget,
+    )
+
+    budget = load_budget(DEFAULT_BUDGET_PATH)
+    assert budget is not None, (
+        f"{DEFAULT_BUDGET_PATH} missing or wrong schema — regenerate "
+        "with `python -m tf_yarn_tpu.analysis --update-hlo-budgets`"
+    )
+    entries = budget["entries"]
+    # the headline baselines are pinned: the tp=2 serving ticks
+    assert entries["models.decode_engine.sharded_step"]["collectives"][
+        "all-reduce"]["count"] == 3
+    assert "all-gather" not in (
+        entries["models.decode_engine.sharded_step"]["collectives"]
+    )
+    assert entries["models.decode_engine.sharded_paged_step"][
+        "collectives"]["all-reduce"]["count"] == 3

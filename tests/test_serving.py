@@ -779,6 +779,9 @@ def test_whole_prompt_replay_matches_legacy(layout_kwargs):
         scheduler.close()
 
 
+@pytest.mark.slow  # tier-1 keeps int8 parity at the engine level
+# (test_paged_step_int8_matches_int8_legacy); this serving-layer twin
+# runs in the full sweep
 def test_paged_int8_serving_matches_int8_legacy():
     """int8 KV through the paged serving stack: the pool pages the int8
     values + scales leaves transparently and streams stay bit-equal to

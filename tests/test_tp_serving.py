@@ -169,6 +169,8 @@ def test_engine_and_scheduler_reject_bad_tp_at_build():
 # bit-parity: tp=2 streams identical to single-device generate_legacy
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # heaviest TP e2e variant; tier-1 keeps the paged
+# prefix-hit e2e + mesh-spec e2e + tp spec decode as TP representatives
 def test_tp_http_dense_greedy_and_sampled_match_legacy():
     """tp=2 dense grid through the REAL HTTP frontend: concurrent
     SAMPLED requests (distinct seeds) stream bit-identically to

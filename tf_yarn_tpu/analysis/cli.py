@@ -1,8 +1,14 @@
-"""`python -m tf_yarn_tpu.analysis` — run both engines, report, gate.
+"""`python -m tf_yarn_tpu.analysis` — run all three engines, report, gate.
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error — so CI can gate
-on it directly (tests/test_analysis.py runs it over `tf_yarn_tpu/` in
-the tier-1 suite).
+One invocation covers the whole stack: AST lints (TYA0xx), jaxpr-level
+entry-point verification (TYA1xx), and compiled-HLO artifact audits
+(TYA2xx) — `--hlo` narrows to the HLO engine alone, `--no-*` flags
+drop individual engines. Per-engine wall time is printed (and included
+in `--json`) so the tier-1 log shows where analysis time goes.
+
+Exit codes: 0 clean, 2 findings, 1 engine/usage error — distinct so CI
+can tell "the code has defects" from "the checker itself broke"
+(tests/test_analysis.py gates on this over `tf_yarn_tpu/` in tier-1).
 """
 
 from __future__ import annotations
@@ -10,18 +16,26 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
 from tf_yarn_tpu.analysis.findings import Finding
 from tf_yarn_tpu.analysis.rules import RULES
+
+# Bumped whenever the --json document shape changes; consumers pin it.
+JSON_SCHEMA_VERSION = 2
+
+EXIT_CLEAN = 0
+EXIT_ERROR = 1
+EXIT_FINDINGS = 2
 
 
 def _parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m tf_yarn_tpu.analysis",
         description="JAX/TPU-aware static checker: AST lints (TYA0xx) + "
-        "jaxpr-level collective/axis verification (TYA1xx). "
-        "Rule catalog: docs/StaticAnalysis.md.",
+        "jaxpr entry-point verification (TYA1xx) + compiled-HLO artifact "
+        "audits (TYA2xx). Rule catalog: docs/StaticAnalysis.md.",
     )
     parser.add_argument(
         "paths", nargs="*", default=["tf_yarn_tpu"],
@@ -29,7 +43,12 @@ def _parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="machine-readable output (findings + primitive counts)",
+        help="machine-readable output (findings + counts + census; "
+        f"json_schema_version {JSON_SCHEMA_VERSION})",
+    )
+    parser.add_argument(
+        "--hlo", action="store_true", dest="hlo_only",
+        help="run ONLY the compiled-HLO engine (skip AST + jaxpr)",
     )
     parser.add_argument(
         "--no-ast", action="store_true", help="skip the AST lint engine"
@@ -37,6 +56,15 @@ def _parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--no-jaxpr", action="store_true",
         help="skip the jaxpr engine (entry-point tracing)",
+    )
+    parser.add_argument(
+        "--no-hlo", action="store_true",
+        help="skip the HLO engine (lower-and-compile audits)",
+    )
+    parser.add_argument(
+        "--update-hlo-budgets", action="store_true",
+        help="rewrite analysis/hlo_budgets.json from this run's census "
+        "instead of diffing against it (review + commit the diff)",
     )
     parser.add_argument(
         "--counts", action="store_true",
@@ -57,8 +85,8 @@ def _parser() -> argparse.ArgumentParser:
 def _force_cpu() -> None:
     """The checker is a host-side tool: it must never dial a TPU relay
     (the axon image pre-imports jax pointed at one; a wedged relay hangs
-    device init past any budget). Tracing needs no devices at all —
-    narrow jax to the CPU platform exactly like tests/conftest.py does."""
+    device init past any budget). Tracing/compiling needs no accelerator
+    — narrow jax to the CPU platform exactly like tests/conftest.py."""
     import os
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -71,45 +99,105 @@ def _force_cpu() -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    args = _parser().parse_args(argv)
+    try:
+        args = _parser().parse_args(argv)
+    except SystemExit as exc:
+        # argparse exits 2 on usage errors and 0 for --help; our exit 2
+        # means "findings", so usage errors become the engine-error code.
+        return EXIT_CLEAN if exc.code == 0 else EXIT_ERROR
 
     if args.list_rules:
         for rule in RULES.values():
             print(f"{rule.code}  [{rule.engine:>5}]  {rule.name}: "
                   f"{rule.summary}")
-        return 0
+        return EXIT_CLEAN
+
+    run_ast = not args.no_ast and not args.hlo_only
+    run_jaxpr = not args.no_jaxpr and not args.hlo_only
+    run_hlo = not args.no_hlo
 
     findings: List[Finding] = []
-    counts = {}
+    suppressed: List[Finding] = []
+    skipped: List[str] = []
+    counts: Dict[str, Dict[str, int]] = {}
+    hlo_census: Dict[str, Dict] = {}
+    engine_seconds: Dict[str, float] = {}
     extra_axes = [a.strip() for a in args.axes.split(",") if a.strip()]
 
-    if not args.no_ast:
+    if run_ast:
         from tf_yarn_tpu.analysis.ast_engine import analyze_paths
 
+        started = time.monotonic()
         try:
             findings.extend(analyze_paths(args.paths, extra_axes=extra_axes))
         except FileNotFoundError as exc:
             print(f"error: no such path: {exc}", file=sys.stderr)
-            return 2
+            return EXIT_ERROR
+        except Exception as exc:
+            print(f"error: ast engine failed: {exc}", file=sys.stderr)
+            return EXIT_ERROR
+        engine_seconds["ast"] = round(time.monotonic() - started, 2)
 
-    skipped: List[str] = []
-    if not args.no_jaxpr:
+    if run_jaxpr:
         _force_cpu()
-        from tf_yarn_tpu.analysis.jaxpr_engine import run as run_jaxpr
+        from tf_yarn_tpu.analysis.jaxpr_engine import run as run_jaxpr_engine
 
-        jaxpr_findings, counts, skipped = run_jaxpr()
+        started = time.monotonic()
+        try:
+            jaxpr_findings, counts, jaxpr_skipped, jaxpr_suppressed = (
+                run_jaxpr_engine()
+            )
+        except Exception as exc:
+            print(f"error: jaxpr engine failed: {exc}", file=sys.stderr)
+            return EXIT_ERROR
         findings.extend(jaxpr_findings)
+        suppressed.extend(jaxpr_suppressed)
+        skipped.extend(jaxpr_skipped)
+        engine_seconds["jaxpr"] = round(time.monotonic() - started, 2)
 
+    if run_hlo:
+        _force_cpu()
+        from tf_yarn_tpu.analysis.hlo_engine import run as run_hlo_engine
+
+        started = time.monotonic()
+        try:
+            report = run_hlo_engine(
+                update_budgets=args.update_hlo_budgets
+            )
+        except Exception as exc:
+            print(f"error: hlo engine failed: {exc}", file=sys.stderr)
+            return EXIT_ERROR
+        findings.extend(report.findings)
+        suppressed.extend(report.suppressed)
+        skipped.extend(report.skipped)
+        hlo_census = report.census
+        engine_seconds["hlo"] = round(time.monotonic() - started, 2)
+        if args.update_hlo_budgets:
+            print(
+                "hlo budgets updated from this run's census "
+                f"({len(hlo_census)} entries)", file=sys.stderr,
+            )
+
+    engines = "+".join(engine_seconds) or "no"
     if args.as_json:
         print(json.dumps({
+            "json_schema_version": JSON_SCHEMA_VERSION,
             "findings": [f.to_json() for f in findings],
+            "suppressed_findings": [f.to_json() for f in suppressed],
             "primitive_counts": counts,
+            "hlo_census": hlo_census,
             "skipped_entries": skipped,
+            "engine_seconds": engine_seconds,
             "n_findings": len(findings),
         }, indent=1, sort_keys=True))
     else:
         for notice in skipped:
             print(f"skipped (environment): {notice}", file=sys.stderr)
+        for finding in suppressed:
+            print(
+                f"suppressed (entry allow=): {finding.format()}",
+                file=sys.stderr,
+            )
         for finding in findings:
             print(finding.format())
         if args.counts and counts:
@@ -121,10 +209,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )[:8]
                 summary = ", ".join(f"{k}={v}" for k, v in top)
                 print(f"  {name}: {total} eqns ({summary})")
+        timing = " ".join(
+            f"{name}={secs}s" for name, secs in engine_seconds.items()
+        )
         print(
             f"{'no findings' if not findings else f'{len(findings)} finding(s)'}"
-            f" ({'ast' if not args.no_ast else ''}"
-            f"{'+' if not args.no_ast and not args.no_jaxpr else ''}"
-            f"{'jaxpr' if not args.no_jaxpr else ''} engines)"
+            f" ({engines} engines; {timing})"
         )
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN

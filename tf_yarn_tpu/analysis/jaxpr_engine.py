@@ -68,6 +68,11 @@ class EntryPoint:
     expected_axes: Optional[Tuple[str, ...]] = None
     hot: bool = True
     requires: Tuple[str, ...] = ()
+    # Per-entry rule suppression — the traced-program twin of the AST
+    # engine's `# noqa` (a jaxpr finding has no source line to comment
+    # on). Codes listed here are filtered from failures but surfaced as
+    # notices in the CLI output, so an `allow` never silently rots.
+    allow: Tuple[str, ...] = ()
 
 
 def capabilities() -> frozenset:
@@ -216,12 +221,16 @@ def check_entry(entry: EntryPoint) -> Tuple[List[Finding], Dict[str, int]]:
 
 def run(
     entries: Optional[Sequence[EntryPoint]] = None,
-) -> Tuple[List[Finding], Dict[str, Dict[str, int]], List[str]]:
+) -> Tuple[List[Finding], Dict[str, Dict[str, int]], List[str],
+           List[Finding]]:
     """Check every entry; returns (findings, {entry: primitive counts},
-    skipped-entry notices)."""
+    skipped-entry notices, suppressed findings). Suppressed findings
+    matched an entry's `allow=` list: they are not failures, but the
+    CLI surfaces them as notices so suppressions stay visible."""
     if entries is None:
         entries = default_entry_points()
     findings: List[Finding] = []
+    suppressed: List[Finding] = []
     all_counts: Dict[str, Dict[str, int]] = {}
     skipped: List[str] = []
     caps = capabilities()
@@ -233,10 +242,14 @@ def run(
             )
             continue
         entry_findings, counts = check_entry(entry)
-        findings.extend(entry_findings)
+        allowed = set(entry.allow)
+        for finding in entry_findings:
+            (suppressed if finding.code in allowed else findings).append(
+                finding
+            )
         if counts:
             all_counts[entry.name] = counts
-    return findings, all_counts, skipped
+    return findings, all_counts, skipped, suppressed
 
 
 # --------------------------------------------------------------------------
@@ -718,6 +731,10 @@ def _decode_entries() -> List[EntryPoint]:
                 ),
                 in_shardings=(param_sh, pool_sh, rep, rep, rep, rep, rep),
                 out_shardings=(pool_sh, rep, rep),
+                # The engine donates pool + rngs (DecodeEngine.paged_step)
+                # — mirrored here so the HLO engine's TYA202 verifies the
+                # aliasing on the same lowering serving actually runs.
+                donate_argnums=(1, 5),
             )
             args = (
                 params, pool,
@@ -748,6 +765,8 @@ def _decode_entries() -> List[EntryPoint]:
             build_step_fn(model, temperature=0.0, top_k=None, top_p=None),
             in_shardings=(param_sh, grid_sh, rep, rep, rep),
             out_shardings=(grid_sh, rep, rep),
+            # Grid + rngs donated exactly as DecodeEngine.step lowers it.
+            donate_argnums=(1, 3),
         )
         args = (
             params, grid,

@@ -1,8 +1,9 @@
-"""The TYA rule catalog: one registry both engines and the docs draw on.
+"""The TYA rule catalog: one registry all engines and the docs draw on.
 
 TYA0xx are AST lints (ast_engine), TYA1xx are jaxpr-level verifications
-(jaxpr_engine). `docs/StaticAnalysis.md` renders this table; keep the
-summaries one line so `--list-rules` stays scannable.
+(jaxpr_engine), TYA2xx are compiled-HLO audits (hlo_engine).
+`docs/StaticAnalysis.md` renders this table; keep the summaries one
+line so `--list-rules` stays scannable.
 """
 
 from __future__ import annotations
@@ -16,7 +17,7 @@ class Rule:
     code: str
     name: str
     summary: str
-    engine: str  # "ast" | "jaxpr"
+    engine: str  # "ast" | "jaxpr" | "hlo"
 
 
 RULES: Dict[str, Rule] = {}
@@ -106,4 +107,37 @@ _register(
     "TYA103", "host-callback-in-hot-path",
     "device_put / pure_callback / io_callback / debug_callback primitive "
     "in a hot-path jaxpr: a host round-trip per step", "jaxpr",
+)
+
+# --- compiled-HLO audits -------------------------------------------------
+_register(
+    "TYA201", "unexpected-collective",
+    "the compiled program's collective census (kinds/counts/payload "
+    "bytes) deviates from the entry's manifest or the hlo_budgets.json "
+    "baseline — a placement typo can silently insert an all-gather",
+    "hlo",
+)
+_register(
+    "TYA202", "broken-donation",
+    "a declared donate_argnums arg has no input_output_alias in the "
+    "compiled artifact: the buffer (KV pool/cache) double-buffers in "
+    "HBM", "hlo",
+)
+_register(
+    "TYA203", "host-round-trip-in-artifact",
+    "infeed/outfeed or a host custom-call target in the compiled "
+    "program — host traffic jaxpr tracing cannot see (compiled "
+    "callbacks, backend-inserted transfers)", "hlo",
+)
+_register(
+    "TYA204", "oversized-replication",
+    "an input above the manifest's byte threshold is materialized "
+    "fully-replicated on a multi-device mesh — size x n_devices of "
+    "HBM for an operand meant to be sharded", "hlo",
+)
+_register(
+    "TYA205", "recompile-churn",
+    "a DecodeEngine program kind compiled more than its budgeted "
+    "distinct cache keys across ticks whose tables/lengths/tokens are "
+    "supposed to be traced — serving recompiles mid-flight", "hlo",
 )

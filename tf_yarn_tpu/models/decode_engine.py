@@ -1520,6 +1520,49 @@ class DecodeEngine:
             (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
         ))
 
+    # -- compiled-artifact introspection -----------------------------------
+    #
+    # The HLO analysis engine (tf_yarn_tpu/analysis/hlo_engine.py) audits
+    # what this engine actually compiled: the cache keys prove tick-to-tick
+    # host inputs stayed traced (TYA205 recompile-churn — a key that varies
+    # across ticks means something that should be a traced value became a
+    # static one), and the executables themselves carry the optimized HLO
+    # (collective census, donation aliasing).
+
+    def _program_caches(self) -> Dict[str, Dict[tuple, Any]]:
+        return {
+            "prefill": self._prefill,
+            "decode": self._decode,
+            "step": self._step,
+            "paged_step": self._paged_step,
+            "pack": self._pack,
+            "spec_step": self._spec_step,
+            "paged_spec_step": self._paged_spec_step,
+        }
+
+    def program_keys(self) -> Dict[str, List[tuple]]:
+        """Every compile-cache key per program kind, in insertion order.
+        One key per kind across a serving run is the recompile-free
+        contract the paged/spec tick programs promise (tables / lengths /
+        tokens are traced); `stats` carries the matching
+        `{kind}_compiles` counters."""
+        with self._lock:
+            return {
+                kind: list(cache)
+                for kind, cache in self._program_caches().items()
+            }
+
+    def compiled_programs(self) -> Dict[str, Dict[tuple, Any]]:
+        """The compiled executables per kind keyed exactly like
+        `program_keys` — each exposes the optimized HLO via
+        `.as_text()`, which is what the TYA2xx compiled-artifact rules
+        read (input_output_alias map, collective ops)."""
+        with self._lock:
+            return {
+                kind: dict(cache)
+                for kind, cache in self._program_caches().items()
+            }
+
     # -- the public entry point --------------------------------------------
 
     def generate(

@@ -1,0 +1,28 @@
+"""TPU kernels (pallas) + partition-aware wrappers.
+
+`DEVICE_CUSTOM_CALL_TARGETS` is the compiled-artifact contract between
+this package and the HLO analysis engine
+(`tf_yarn_tpu/analysis/hlo_engine.py`, rule TYA203 host-round-trip):
+custom-call targets listed here are *device* kernels — a pallas kernel
+lowered for TPU, or an SPMD partitioner marker — and must never be
+flagged as host traffic. Anything callback-shaped that is NOT listed
+(`xla_python_cpu_callback`, FFI python callbacks, infeed/outfeed) is a
+host round-trip inside a compiled program, which in a per-tick serving
+program means one device<->host sync per generated token.
+
+Keep this list tight: adding a target here exempts it from TYA203
+everywhere, which is exactly the kind of blanket suppression the
+per-entry `allow=` mechanism exists to avoid.
+"""
+
+# Targets emitted when pallas kernels lower for real accelerators
+# (CPU's interpret mode lowers to plain HLO and emits none), plus the
+# GSPMD partitioner's sharding markers, which survive into pre-optimized
+# artifacts.
+DEVICE_CUSTOM_CALL_TARGETS = frozenset({
+    "tpu_custom_call",          # pallas/mosaic kernels on TPU
+    "mosaic_gpu",               # pallas kernels on GPU (future-proofing)
+    "Sharding",                 # GSPMD sharding annotation marker
+    "SPMDFullToShardShape",     # shard_map boundary markers
+    "SPMDShardToFullShape",
+})

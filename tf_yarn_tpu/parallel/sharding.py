@@ -202,6 +202,54 @@ def shard_like_annotated(mesh: Mesh, abstract_tree, tree):
     return jax.tree_util.tree_map(_place, tree, shardings)
 
 
+def oversized_replicated_leaves(shardings, avals, threshold_bytes: int):
+    """Leaves placed fully-replicated on a multi-device mesh despite being
+    larger than `threshold_bytes` — the TYA204 (oversized-replication)
+    probe of the HLO analysis engine (docs/StaticAnalysis.md).
+
+    A replicated leaf costs `size × n_devices` HBM; for weights that
+    LOGICAL_RULES meant to shard, full replication is almost always a
+    placement typo (a logical name missing from the rules, or a
+    PartitionSpec() slipping through an unannotated path). Tiny leaves
+    (norm scales, biases) are legitimately replicated — the threshold
+    separates the two.
+
+    `shardings` and `avals` are matching pytrees of NamedSharding /
+    PartitionSpec leaves and ShapeDtypeStruct-likes. Returns
+    `[(path, nbytes), ...]` for offending leaves, largest first."""
+    flagged = []
+
+    def _visit(path, sharding, aval):
+        shape = tuple(getattr(aval, "shape", ()))
+        dtype = getattr(aval, "dtype", None)
+        if dtype is None or not shape:
+            return
+        nbytes = int(dtype.itemsize)
+        for dim in shape:
+            nbytes *= int(dim)
+        if nbytes <= threshold_bytes:
+            return
+        spec = getattr(sharding, "spec", sharding)
+        if not isinstance(spec, PartitionSpec):
+            return
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and getattr(mesh, "size", 1) <= 1:
+            return
+        if any(axis is not None for axis in tuple(spec)):
+            return
+        flagged.append((jax.tree_util.keystr(path), nbytes))
+
+    specs_flat, treedef = jax.tree_util.tree_flatten_with_path(
+        shardings,
+        is_leaf=lambda node: isinstance(node, (NamedSharding, PartitionSpec)),
+    )
+    avals_flat = treedef.flatten_up_to(avals)
+    for (path, sharding), aval in zip(specs_flat, avals_flat):
+        _visit(path, sharding, aval)
+    flagged.sort(key=lambda item: -item[1])
+    return flagged
+
+
 def unbox_params(tree):
     """Strip flax Partitioned boxes, leaving raw arrays (used after placement
     decisions are extracted, so apply() sees plain params).

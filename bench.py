@@ -410,6 +410,18 @@ def _stale_tpu_fields() -> dict:
     for key, value in fleet.items():
         if str(key).startswith("scaling_"):
             fields[f"last_tpu_fleet_{key}"] = value
+    rank = table.get("rank") or {}
+    for row_name, row in (rank.get("rows") or {}).items():
+        if isinstance(row, dict) and "requests_per_sec" in row:
+            fields[f"last_tpu_rank_{row_name}_requests_per_sec"] = row[
+                "requests_per_sec"
+            ]
+            fields[f"last_tpu_rank_{row_name}_latency_p95_ms"] = row.get(
+                "latency_p95_ms"
+            )
+            fields[f"last_tpu_rank_{row_name}_rows_per_tick"] = row.get(
+                "rows_per_tick"
+            )
     return fields
 
 
@@ -609,8 +621,8 @@ def bench_flagship_train():
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "rows": table,
     }
-    for section in ("decode", "long_context", "serve", "fleet", "bert_base",
-                    "resnet50", "vit_base"):
+    for section in ("decode", "long_context", "serve", "fleet", "rank",
+                    "bert_base", "resnet50", "vit_base"):
         if previous.get(section):
             ab[section] = {
                 **previous[section],
@@ -730,6 +742,24 @@ def bench_flagship_train():
             _log(f"fleet: {fleet}")
         except Exception as exc:
             _log(f"fleet bench FAILED: {type(exc).__name__}: {exc}")
+        try:
+            rank = suite.bench_rank(tpu=True)
+            ab["rank"] = rank
+            _write_ab(ab)
+            # Ranking micro-batch headline: requests/s + tail latency
+            # per max_wait_ms row — the fill-or-timeout policy trade
+            # (docs/Ranking.md) measured on the Criteo-shape DLRM.
+            for row_name, row in (rank.get("rows") or {}).items():
+                if isinstance(row, dict) and "requests_per_sec" in row:
+                    result[f"rank_{row_name}_requests_per_sec"] = row[
+                        "requests_per_sec"
+                    ]
+                    result[f"rank_{row_name}_latency_p95_ms"] = row.get(
+                        "latency_p95_ms"
+                    )
+            _log(f"rank: {rank}")
+        except Exception as exc:
+            _log(f"rank bench FAILED: {type(exc).__name__}: {exc}")
         try:
             longctx = suite.bench_long_context(tpu=True)
             # Fresh measurement replaces any carried-forward stale section.

@@ -1205,6 +1205,133 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
     return result
 
 
+def bench_rank(tpu: bool, waits_ms=(0.0, 2.0, 5.0)):
+    """Online-ranking micro-batch bench: ONE seeded Poisson arrival
+    trace of feature batches replayed through the fill-or-timeout
+    scheduler (tf_yarn_tpu/ranking/) at max_wait_ms ∈ {0, 2, 5} —
+    the batching-policy knob's whole trade in three rows. `wait0` is
+    tick-on-arrival (best p50, one engine call per request); larger
+    waits coalesce rows per compiled forward, buying requests/s with
+    queue latency. Every row shares the trace AND the engine, so the
+    deltas are policy-only (no recompiles inside the timed window)."""
+    import threading
+    import time
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.dlrm import DLRM, DLRMConfig
+    from tf_yarn_tpu.models.rank_engine import RankEngine
+    from tf_yarn_tpu.parallel.mesh import select_devices
+    from tf_yarn_tpu.ranking.scheduler import MicroBatchScheduler
+
+    select_devices()
+    if tpu:
+        config = DLRMConfig.criteo()
+        n_requests, mean_gap_s, row_choices = 256, 0.002, (1, 2, 4, 8)
+        max_batch, buckets = 64, (1, 2, 4, 8, 16, 32, 64)
+    else:
+        config = DLRMConfig.tiny()
+        n_requests, mean_gap_s, row_choices = 48, 0.003, (1, 2, 4)
+        max_batch, buckets = 8, (1, 2, 4, 8)
+    model = DLRM(config)
+    rng = np.random.RandomState(0)
+    sizes = np.asarray(config.table_sizes)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, len(sizes)), jnp.int32),
+        jnp.zeros((1, config.n_dense), jnp.float32),
+    ))
+    engine = RankEngine(model, batch_buckets=buckets)
+
+    # One seeded Poisson trace shared by every max_wait_ms row.
+    arrivals = np.cumsum(rng.exponential(mean_gap_s, n_requests))
+    trace = []
+    for index in range(n_requests):
+        rows = int(rng.choice(row_choices))
+        trace.append((
+            float(arrivals[index]),
+            rng.randint(0, sizes, (rows, len(sizes))).astype(np.int32),
+            rng.randn(rows, config.n_dense).astype(np.float32),
+        ))
+    total_rows = sum(cat.shape[0] for _, cat, _ in trace)
+
+    def run_row(max_wait_ms):
+        scheduler = MicroBatchScheduler(
+            engine, params, max_batch=max_batch,
+            max_wait_ms=max_wait_ms, queue_capacity=n_requests,
+        )
+        # Warmup compiles every bucket outside the timed window (cache
+        # hits from the second row on — the engine is shared).
+        engine.warmup(scheduler.params, max_batch=max_batch)
+        ticks_before = scheduler.stats()["ticks"]
+        scheduler.start()
+        try:
+            latencies = [None] * n_requests
+
+            def client(index, offset, cat, dense, t0):
+                lag = t0 + offset - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                scheduler.submit(cat, dense).result(timeout=600)
+                # Measured against the TRACE arrival, so queue wait —
+                # the cost max_wait_ms deliberately adds — counts.
+                latencies[index] = time.perf_counter() - (t0 + offset)
+
+            threads = []
+            t0 = time.perf_counter()
+            for index, (offset, cat, dense) in enumerate(trace):
+                thread = threading.Thread(
+                    target=client, args=(index, offset, cat, dense, t0)
+                )
+                thread.start()
+                threads.append(thread)
+            for thread in threads:
+                thread.join(timeout=900)
+            wall = time.perf_counter() - t0
+            done = sorted(lat for lat in latencies if lat is not None)
+            stats = scheduler.stats()
+            ticks = stats["ticks"] - ticks_before
+            return {
+                "max_wait_ms": max_wait_ms,
+                "completed": len(done),
+                "requests_per_sec": round(len(done) / wall, 2),
+                "rows_per_sec": round(total_rows / wall, 2),
+                "latency_p50_ms": round(
+                    1000 * done[len(done) // 2], 2),
+                "latency_p95_ms": round(
+                    1000 * done[int(0.95 * (len(done) - 1))], 2),
+                "ticks": ticks,
+                "rows_per_tick": round(total_rows / ticks, 2)
+                if ticks else None,
+            }
+        finally:
+            scheduler.close()
+
+    rows = {}
+    for wait in waits_ms:
+        name = f"wait{wait:g}ms"
+        try:
+            rows[name] = run_row(wait)
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            rows[name] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    return {
+        "requests": n_requests,
+        "total_rows": total_rows,
+        "max_batch": max_batch,
+        "mean_gap_ms": mean_gap_s * 1000,
+        "forward_compiles": engine.stats["forward_compiles"],
+        "rows": rows,
+        "note": (
+            "one shared trace + engine per row: requests/s and p95 vs "
+            "max_wait_ms is the fill-or-timeout policy trade, nothing "
+            "else"
+        ),
+    }
+
+
 def bench_ici_allreduce(tpu: bool):
     from tf_yarn_tpu.parallel.collectives import allreduce_bandwidth
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -1226,6 +1353,7 @@ CONFIGS = {
     "decode": bench_decode,
     "serve": bench_serve,
     "fleet": bench_fleet,
+    "rank": bench_rank,
     "ici_allreduce": bench_ici_allreduce,
 }
 

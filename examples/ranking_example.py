@@ -1,0 +1,145 @@
+"""Online ranking demo: micro-batched DLRM scoring behind /v1/rank.
+
+Builds a tiny DLRM, starts the ranking stack in-process (fill-or-timeout
+MicroBatchScheduler + threaded HTTP frontend — the same pieces the
+`rank` task type runs through the launcher), fires a burst of concurrent
+HTTP requests with mixed row counts, and prints each request's scores
+plus the scheduler snapshot — watch `ticks` come out well below the
+request count (requests coalesced into shared compiled forwards) and
+`forward_cache_hits` dwarf `forward_compiles` (the bucketed programs
+compile once at warmup, then every tick is a cache hit).
+
+Every score is also checked bitwise against a direct jitted forward of
+the same params — micro-batching and ceil-padding to a batch bucket are
+performance decisions, not accuracy decisions (docs/Ranking.md
+"Correctness contract").
+
+`python examples/ranking_example.py --tp` runs the EMBEDDING-SHARDED
+variant (docs/Ranking.md "Sharding layout"): the stacked embedding
+table splits row-wise across 2 (virtual, on CPU) devices, XLA inserts
+the one lookup all-reduce from the placements, and the scores are
+bitwise identical to the unsharded run — the printout shows per-device
+vs total parameter bytes.
+"""
+
+import http.client
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("TPU_YARN_PLATFORM", os.environ.get("EXAMPLE_PLATFORM", "cpu"))
+if "--tp" in sys.argv[1:] and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # Must land before the first jax call in this process: the tp demo
+    # needs 2 devices; on the CPU platform that means virtual host
+    # devices (the same switch the test rig's conftest flips).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+
+
+def main(tp: bool = False) -> None:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_yarn_tpu.models.dlrm import DLRM, DLRMConfig
+    from tf_yarn_tpu.models.rank_engine import RankEngine
+    from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh, select_devices
+    from tf_yarn_tpu.ranking import MicroBatchScheduler, RankServer
+
+    # float32 so the JSON round-trip is exact and the bitwise check
+    # below can compare served floats to the direct forward directly.
+    config = DLRMConfig.tiny(dtype=jnp.float32)
+    model = DLRM(config)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, len(config.table_sizes)), jnp.int32),
+        jnp.zeros((1, config.n_dense), jnp.float32),
+    ))
+    mesh = None
+    if tp:
+        # Embedding-sharded replica: the table's rows split over the tp
+        # axis, everything else replicates — the rank task does exactly
+        # this from RankingExperiment(mesh_spec=MeshSpec(tp=2)).
+        mesh = build_mesh(MeshSpec(tp=2), select_devices(2))
+    engine = RankEngine(model, batch_buckets=(1, 2, 4, 8), mesh=mesh)
+
+    # max_wait_ms=5 is the coalescing window: a request waits up to 5ms
+    # for company before its tick fires (docs/Ranking.md "Micro-batch
+    # tuning"; `benchmarks/run.py rank` sweeps this knob).
+    scheduler = MicroBatchScheduler(
+        engine, params, max_batch=8, max_wait_ms=5.0
+    )
+    compiles = engine.warmup(scheduler.params, max_batch=8)
+    scheduler.start()
+    server = RankServer(scheduler)
+    server.start()
+    stats0 = scheduler.stats()
+    print(f"ranking on {server.endpoint} (max_batch=8, max_wait_ms=5.0, "
+          f"{compiles} bucket programs warmed"
+          + (f", tp={stats0['tp_degree']}: "
+             f"{stats0['params_hbm_bytes_per_device']} param bytes/device"
+             if tp else "") + ")")
+
+    rng = np.random.RandomState(0)
+    n_tables = len(config.table_sizes)
+    bodies = []
+    for batch in (1, 3, 2, 4, 1, 3):
+        bodies.append({
+            "cat": rng.randint(0, 1_000_000, (batch, n_tables)).tolist(),
+            "dense": rng.randn(batch, config.n_dense).round(3).tolist(),
+        })
+    results = {}
+
+    def call(index):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=300
+        )
+        conn.request(
+            "POST", "/v1/rank", json.dumps(bodies[index]),
+            {"Content-Type": "application/json"},
+        )
+        results[index] = json.loads(conn.getresponse().read())
+        conn.close()
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(bodies))]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+
+    # The parity oracle: a plain jitted forward on the exact (unpadded,
+    # uncoalesced) batch. Served scores must match it bit for bit.
+    direct = jax.jit(model.apply)
+    for index, body in enumerate(bodies):
+        reply = results[index]
+        want = np.asarray(direct(
+            scheduler.params,
+            jnp.asarray(body["cat"], jnp.int32),
+            jnp.asarray(body["dense"], jnp.float32),
+        ), np.float32).squeeze(-1)
+        bitwise = reply["scores"] == [float(v) for v in want]
+        print(f"request {index}: rows={len(body['cat'])} -> "
+              f"{[round(s, 4) for s in reply['scores']]} "
+              f"({reply['finish_reason']}, bitwise={bitwise})")
+        assert bitwise, f"request {index} diverged from the direct forward"
+
+    stats = scheduler.stats()
+    print(f"\n{stats['requests_total']} requests, {stats['rows_scored']} "
+          f"rows in {stats['ticks']} ticks "
+          f"(avg {stats['avg_batch_rows']} rows/tick — coalescing); "
+          f"engine: {stats['rank_engine']['forward_compiles']} compiles, "
+          f"{stats['rank_engine']['forward_cache_hits']} cache hits")
+
+    server.stop()
+    scheduler.close()
+
+
+if __name__ == "__main__":
+    main(tp="--tp" in sys.argv[1:])

@@ -142,12 +142,15 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
         "tf_yarn_tpu/telemetry",
         "tf_yarn_tpu/resilience",
         "tf_yarn_tpu/serving",
+        "tf_yarn_tpu/ranking",
         "tf_yarn_tpu/fleet",
         "tf_yarn_tpu/training.py",
         "tf_yarn_tpu/inference.py",
         "tf_yarn_tpu/models/decode_engine.py",
+        "tf_yarn_tpu/models/rank_engine.py",
         "tf_yarn_tpu/models/spec.py",
         "tf_yarn_tpu/tasks/serving.py",
+        "tf_yarn_tpu/tasks/rank.py",
         "tf_yarn_tpu/tasks/router.py",
         "tf_yarn_tpu/checkpoint.py",
         "tf_yarn_tpu/client.py",

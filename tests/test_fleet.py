@@ -485,7 +485,7 @@ def test_router_503_with_retry_after_when_no_replica_healthy():
         assert headers.get("Retry-After") == "2"
         payload = json.loads(raw)
         assert payload["retry_after_s"] == 2.0
-        assert "no serving replica" in payload["error"]
+        assert "no generate replica" in payload["error"]
         assert router.stats()["routed_requests"]["-"]["no_replica"] == 1
     finally:
         router.stop()
@@ -676,7 +676,9 @@ def test_router_task_type_wiring():
     assert specs["router"].label is NodeLabel.CPU
     # A router with zero serving replicas can never serve: reject at
     # topology build, not at 3am when the fleet launches empty.
-    with pytest.raises(ValueError, match="at least one serving replica"):
+    with pytest.raises(
+        ValueError, match="at least one serving or rank replica"
+    ):
         check_topology({
             "chief": TaskSpec(instances=1, chips_per_host=1,
                               label=NodeLabel.TPU),

@@ -7,6 +7,8 @@ This is the coverage level SURVEY.md §4 says the reference never reaches
 (its CI mocks the cluster entirely).
 """
 
+import pytest
+
 from tf_yarn_tpu.client import run_on_tpu
 from tf_yarn_tpu.topologies import TaskSpec
 
@@ -184,6 +186,9 @@ def _staged_remote_experiment_fn(
     return experiment_fn
 
 
+@pytest.mark.slow  # second-heaviest multi-process launch; tier-1 keeps
+# the multihost drain e2e above + single-process staged-checkpoint
+# coverage in test_fs
 def test_multihost_staged_remote_checkpointing(tmp_path):
     """Staged (hdfs://-class) model_dir under 2 real processes: the global
     state is gathered to host 0, which stages+uploads one complete

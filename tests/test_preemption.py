@@ -163,6 +163,8 @@ def test_chaos_sigterm_drains_saves_and_classifies_preempted(tmp_path):
     assert ckpt_lib.list_checkpoint_steps(model_dir)[-1] == 6
 
 
+@pytest.mark.slow  # full launcher relaunch cycle; tier-1 keeps the
+# in-process drain/resume above + the chaos driver in test_resilience
 def test_launcher_retry_recovers_from_preemption(tmp_path):
     # Full path: Preempted ships through the stop event, the driver's
     # nb_retries relaunch resumes from the saved checkpoint.

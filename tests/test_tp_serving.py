@@ -456,10 +456,12 @@ def test_tp_step_program_has_allreduce_and_no_host_callbacks():
         assert "all-reduce" in compiled.as_text(), \
             "no TP collective in a sharded step program"
 
-    # The analysis twins: both sharded entries trace clean on this rig.
+    # The analysis twins: both sharded DECODE entries trace clean on
+    # this rig (the rank engine's sharded twin has its own coverage in
+    # test_analysis / test_ranking).
     entries = {
         e.name: e for e in default_entry_points()
-        if "sharded" in e.name
+        if "sharded" in e.name and "decode_engine" in e.name
     }
     assert set(entries) == {
         "models.decode_engine.sharded_step",

@@ -14,6 +14,7 @@ TENSORBOARD_MODULE = "tf_yarn_tpu.tasks.tensorboard"
 EVALUATOR_MODULE = "tf_yarn_tpu.tasks.evaluator"
 SERVING_MODULE = "tf_yarn_tpu.tasks.serving"
 ROUTER_MODULE = "tf_yarn_tpu.tasks.router"
+RANK_MODULE = "tf_yarn_tpu.tasks.rank"
 
 
 def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) -> str:
@@ -25,4 +26,6 @@ def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) ->
         return custom_task_module or SERVING_MODULE
     if task_type == "router":
         return custom_task_module or ROUTER_MODULE
+    if task_type == "rank":
+        return custom_task_module or RANK_MODULE
     return custom_task_module or WORKER_MODULE

@@ -716,6 +716,24 @@ def default_entries() -> List[HloEntry]:
             ),
             requires=("multi_device",),
         ),
+        # The ranking tick (models/rank_engine.py): a bucketed DLRM
+        # forward, zero collectives single-device.
+        _entry("models.rank_engine.forward"),
+        # The EMBEDDING-SHARDED ranking forward. GSPMD resolves the
+        # lookup into tp-sharded tables as masked partial lookups plus
+        # exactly ONE batch-sized all-reduce (the gathered embedding
+        # rows: batch x tables x embed_dim floats — 1KB here), and must
+        # NOT emit an all-gather above the small floor: an all-gather
+        # would re-materialize the full tables per tick, the exact HBM
+        # blowup sharding them 1/tp per device exists to avoid.
+        _entry(
+            "models.rank_engine.sharded_forward",
+            Manifest(
+                collectives={"all-reduce": 1, "all-gather": 0},
+                max_replicated_bytes=replicated_budget,
+            ),
+            requires=("multi_device",),
+        ),
     ]
 
 
@@ -769,6 +787,39 @@ def _decode_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
     return drive
 
 
+def _rank_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
+    def drive():
+        import jax
+        import numpy as np
+
+        from tf_yarn_tpu.models.dlrm import DLRM, DLRMConfig
+        from tf_yarn_tpu.models.rank_engine import RankEngine
+
+        config = DLRMConfig.tiny()
+        model = DLRM(config)
+        engine = RankEngine(model, batch_buckets=(4,))
+        params = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, len(config.table_sizes)), np.int32),
+            np.zeros((1, config.n_dense), np.float32),
+        )
+        rng = np.random.default_rng(0)
+        for batch in (1, 3, 4, 2):
+            # Every per-tick input varies: ids, dense values, batch
+            # size (all inside the one bucket). A cache keyed on any
+            # of them recompiles here.
+            cat = rng.integers(
+                0, 64, (batch, len(config.table_sizes))
+            ).astype(np.int32)
+            dense = rng.standard_normal(
+                (batch, config.n_dense)
+            ).astype(np.float32)
+            engine.rank(params, cat, dense)
+        return engine.program_keys()
+
+    return drive
+
+
 def default_churn_entries() -> List[ChurnEntry]:
     return [
         ChurnEntry(
@@ -777,5 +828,13 @@ def default_churn_entries() -> List[ChurnEntry]:
             # One compiled program per kind across 3 ticks of varying
             # tokens/rngs/tables/lengths — those are traced, never keys.
             expected={"step": 1, "paged_step": 1},
+        ),
+        ChurnEntry(
+            "models.rank_engine.rank_churn",
+            _rank_churn_driver,
+            # Four micro-batches of varying size inside one bucket:
+            # ids/values are traced and padding normalizes the shape,
+            # so exactly one compiled forward may exist.
+            expected={"forward": 1},
         ),
     ]

@@ -828,6 +828,86 @@ def _decode_entries() -> List[EntryPoint]:
     ]
 
 
+def _rank_entries() -> List[EntryPoint]:
+    """The ranking tick's device program (models/rank_engine.py): one
+    bucketed DLRM forward per micro-batch. Hot — the scheduler
+    dispatches it once per tick under a request deadline, so a host
+    callback here turns the single planned host sync (the score
+    readback) into several."""
+
+    def _dlrm_avals():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.dlrm import DLRM, DLRMConfig
+
+        config = DLRMConfig.tiny()
+        model = DLRM(config)
+        cat = jax.ShapeDtypeStruct(
+            (8, len(config.table_sizes)), jnp.int32
+        )
+        dense = jax.ShapeDtypeStruct((8, config.n_dense), jnp.float32)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        abstract = jax.eval_shape(
+            lambda r, c, d: model.init(r, c, d), rng, cat, dense
+        )
+        return model, abstract, cat, dense
+
+    def forward():
+        from tf_yarn_tpu.models.rank_engine import build_rank_fn
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+        model, abstract, cat, dense = _dlrm_avals()
+        params = sharding_lib.unbox_params(abstract)
+        return (
+            build_rank_fn(model, has_dense=True),
+            (params, cat, dense),
+            {},
+        )
+
+    def sharded_forward():
+        """The EMBEDDING-SHARDED forward, lowered exactly as RankEngine
+        lowers it under a mesh: params placed by RANKING_RULES (tables
+        1/tp per device), replicated features in, replicated scores
+        out. The embedding all-gather is inserted by the XLA
+        partitioner at compile — the HLO engine's TYA201 manifest pins
+        its census; this entry verifies the traced program is
+        host-callback-free and any named-axis collective stays in the
+        tp vocabulary."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from tf_yarn_tpu.models.rank_engine import build_rank_fn
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+        from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        tp = 2
+        model, abstract, cat, dense = _dlrm_avals()
+        mesh = build_mesh(MeshSpec(tp=tp), jax.devices()[:tp])
+        rep = NamedSharding(mesh, PartitionSpec())
+        param_sh = sharding_lib.tree_shardings(
+            mesh, abstract, rules=sharding_lib.RANKING_RULES
+        )
+        params = sharding_lib.unbox_params(abstract)
+        fn = jax.jit(
+            build_rank_fn(model, has_dense=True),
+            in_shardings=(param_sh, rep, rep),
+            out_shardings=rep,
+        )
+        return fn, (params, cat, dense), {}
+
+    from tf_yarn_tpu.parallel.mesh import AXIS_TP
+
+    return [
+        EntryPoint("models.rank_engine.forward", forward),
+        EntryPoint(
+            "models.rank_engine.sharded_forward", sharded_forward,
+            axis_env=((AXIS_TP, 2),), expected_axes=(AXIS_TP,),
+            requires=("multi_device",),
+        ),
+    ]
+
+
 def default_entry_points() -> List[EntryPoint]:
     return (
         _ops_entries()
@@ -835,4 +915,5 @@ def default_entry_points() -> List[EntryPoint]:
         + _parallel_entries()
         + _model_entries()
         + _decode_entries()
+        + _rank_entries()
     )

@@ -45,8 +45,9 @@ KILLED = "KILLED"
 # tensorboard self-terminate after the training tasks stop
 # (evaluator_task.py:21-35, _tensorboard_task.py:54-58). Serving tasks
 # ARE primary: a crashed server fails (and relaunches) the run — and so
-# is the fleet router, the one endpoint every client dials.
-PRIMARY_TASK_TYPES = ("chief", "worker", "serving", "router")
+# are ranking replicas and the fleet router, the one endpoint every
+# client dials.
+PRIMARY_TASK_TYPES = ("chief", "worker", "serving", "rank", "router")
 
 
 @dataclass

@@ -318,6 +318,17 @@ def _execute_and_await_termination(
             for key in cluster.handle.tasks()
             if key.type == "serving"
         ]
+        # Ranking replicas likewise (tf_yarn_tpu.ranking) — distinct
+        # key suffix, because it doubles as the capability declaration
+        # the fleet registry reads.
+        + [
+            (
+                event.rank_endpoint_event_name(key.to_kv_str()),
+                "rank endpoint",
+            )
+            for key in cluster.handle.tasks()
+            if key.type == "rank"
+        ]
         # And the fleet router's — the one endpoint clients dial in a
         # fleet topology (tf_yarn_tpu.fleet).
         + [

@@ -53,6 +53,12 @@ SERVING_ENDPOINT = "serving_endpoint"
 # fleet topology (the serving endpoints behind it stay advertised too,
 # for direct access and for the router's own registry).
 ROUTER_ENDPOINT = "router_endpoint"
+# Ranking discovery (tf_yarn_tpu.ranking): a DIFFERENT key suffix than
+# serving's, deliberately — the suffix is the endpoint's capability
+# declaration. The fleet registry derives each replica's kind from
+# which key it advertised, so the router's path-aware dispatch never
+# sends a /v1/rank request to a token-decode replica.
+RANK_ENDPOINT = "rank_endpoint"
 
 
 def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
@@ -157,6 +163,17 @@ def router_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
 
 def router_endpoint_event_name(task: str) -> str:
     return f"{task}/{ROUTER_ENDPOINT}"
+
+
+def rank_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
+    """Advertise a ranking task's HTTP endpoint (``host:port``). The
+    distinct suffix doubles as the replica's capability declaration —
+    see RANK_ENDPOINT."""
+    broadcast(kv, f"{task}/{RANK_ENDPOINT}", endpoint)
+
+
+def rank_endpoint_event_name(task: str) -> str:
+    return f"{task}/{RANK_ENDPOINT}"
 
 
 def metrics_event(kv: KVStore, task: str, payload: str) -> None:

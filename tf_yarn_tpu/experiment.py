@@ -23,7 +23,7 @@ Loss contract everywhere: ``loss_fn(model, params, batch, rng) ->
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional
+from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 from tf_yarn_tpu.parallel.mesh import AXIS_TP, MeshSpec
 
@@ -459,6 +459,103 @@ class ServingExperiment:
 
 
 @dataclasses.dataclass
+class RankingExperiment:
+    """Online-ranking job: load (or deterministically init) DLRM-class
+    params and serve ``/v1/rank`` with fill-or-timeout micro-batching
+    until stopped (tf_yarn_tpu/ranking/, docs/Ranking.md). The second
+    serving workload class: stateless, latency-bound feature batches —
+    no KV cache, no slots, capacity freed every tick.
+
+    ``max_batch``/``max_wait_ms`` are the micro-batch policy: tick when
+    the queued rows fill ``max_batch`` OR the oldest waiter has aged
+    ``max_wait_ms`` (0 = tick on arrival; `benchmarks/run.py rank`
+    sweeps the trade). ``model_dir=None`` serves a deterministic
+    ``init_seed`` init instead of a checkpoint (demos, tests — any peer
+    with the same model + seed reproduces the params bit-for-bit).
+
+    ``mesh_spec`` turns on EMBEDDING-SHARDED inference: MeshSpec(tp=N)
+    splits the stacked embedding table's rows over N devices through
+    ``parallel.sharding.RANKING_RULES`` (dense/MLP replicated), XLA
+    inserting the lookup collectives — the serving twin of the
+    reference's PS-sharded weight table. Ranking shards tensor-parallel
+    only, and tp must divide ``sum(table_sizes)``; both fail HERE with
+    the knob's name, before any params load.
+    """
+
+    model: Any
+    model_dir: Optional[str] = None
+    host: str = "0.0.0.0"
+    port: int = 0  # 0 = ephemeral; the bound port is advertised via KV
+    max_batch: int = 32
+    max_wait_ms: float = 2.0
+    queue_capacity: int = 256
+    retry_after_s: float = 0.5
+    batch_buckets: Optional[Tuple[int, ...]] = None
+    warmup: bool = True
+    init_seed: int = 0
+    step: Optional[int] = None  # checkpoint step; None = latest
+    serve_seconds: Optional[float] = None
+    mesh_spec: Optional[MeshSpec] = None
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(
+                f"max_batch must be >= 1, got {self.max_batch}"
+            )
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        if self.queue_capacity < 1:
+            raise ValueError(
+                f"queue_capacity must be >= 1, got {self.queue_capacity}"
+            )
+        if self.serve_seconds is not None and self.serve_seconds <= 0:
+            raise ValueError(
+                f"serve_seconds must be > 0 or None, got "
+                f"{self.serve_seconds}"
+            )
+        if self.batch_buckets is not None and (
+            not self.batch_buckets or min(self.batch_buckets) < 1
+        ):
+            raise ValueError(
+                f"batch_buckets must be a non-empty tuple of positive "
+                f"sizes or None, got {self.batch_buckets!r}"
+            )
+        config = getattr(self.model, "config", None)
+        if config is None or not hasattr(config, "table_sizes"):
+            raise ValueError(
+                "RankingExperiment.model must be a DLRM-class model "
+                "exposing config.table_sizes (the ranking engine reads "
+                "it for feature validation and table sharding)"
+            )
+        if self.mesh_spec is not None:
+            # Same posture as ServingExperiment: bad TP configs fail at
+            # build time with the knob's name, not as a partitioner
+            # symptom after the restore.
+            spec = self.mesh_spec
+            other = {
+                name: size
+                for name, size in zip(spec.axis_names, spec.axis_sizes)
+                if name != AXIS_TP and size != 1
+            }
+            if other:
+                raise ValueError(
+                    f"ranking shards tensor-parallel only: mesh_spec "
+                    f"axes {other} must be 1 (replica parallelism is "
+                    "the fleet router's job — docs/Fleet.md)"
+                )
+            total = int(sum(config.table_sizes))
+            if spec.tp > 1 and total % spec.tp:
+                raise ValueError(
+                    f"mesh_spec tp={spec.tp} does not divide the stacked "
+                    f"embedding table's {total} rows "
+                    "(sum(model.config.table_sizes)) — each device must "
+                    "hold an equal table shard"
+                )
+
+
+@dataclasses.dataclass
 class CoreExperiment:
     """Normalized form consumed by training.train_and_evaluate."""
 
@@ -543,7 +640,7 @@ def as_core_experiment(experiment: Any) -> CoreExperiment:
 
 EXPERIMENT_TYPES = (
     JaxExperiment, ExperimentSpec, KerasExperiment, InferenceExperiment,
-    ServingExperiment,
+    ServingExperiment, RankingExperiment,
 )
 
 
@@ -567,6 +664,11 @@ def run_experiment(runtime, experiment: Any) -> None:
                 from tf_yarn_tpu.serving.server import run_serving
 
                 run_serving(experiment, runtime=runtime)
+                return
+            if isinstance(experiment, RankingExperiment):
+                from tf_yarn_tpu.ranking.server import run_ranking
+
+                run_ranking(experiment, runtime=runtime)
                 return
             from tf_yarn_tpu import training
 

@@ -3,10 +3,15 @@
 Discovery and liveness ride the SAME coordination-KV protocol the rest
 of the runtime already speaks — no second control plane:
 
-* **discovery** — each serving replica advertises its HTTP endpoint as
-  ``{task}/serving_endpoint`` (event.serving_endpoint_event); the
-  registry watches those keys (an explicit task list from the cluster
-  spec, or a prefix scan when none is given).
+* **discovery** — each replica advertises its HTTP endpoint as
+  ``{task}/serving_endpoint`` (event.serving_endpoint_event, generate
+  replicas) or ``{task}/rank_endpoint`` (event.rank_endpoint_event,
+  ranking replicas); the registry watches those keys (an explicit task
+  list from the cluster spec, or a prefix scan when none is given).
+  The suffix a replica advertised under IS its capability declaration:
+  the registry records it as ``Replica.kind`` (``"generate"`` or
+  ``"rank"``) and the router only routes a request to replicas whose
+  kind matches the request path (``healthy(kind=...)``).
 * **admission** — an advertised endpoint is NOT routable yet: the
   replica stays ``pending`` until its first successful ``/healthz``
   probe (a replica publishes its endpoint before the first tick has
@@ -54,6 +59,12 @@ STOPPED = "stopped"    # tombstoned / stop event: finished, not dead
 DEFAULT_PROBE_TIMEOUT_S = 2.0
 DEFAULT_PROBE_INTERVAL_S = 1.0
 
+# Replica capability kinds, keyed by the KV suffix the replica
+# advertised its endpoint under (the suffix IS the declaration — a
+# replica that publishes rank_endpoint serves /v1/rank, nothing else).
+KIND_GENERATE = "generate"
+KIND_RANK = "rank"
+
 
 def http_probe(endpoint: str,
                timeout: float = DEFAULT_PROBE_TIMEOUT_S) -> dict:
@@ -77,11 +88,15 @@ def http_probe(endpoint: str,
 
 @dataclasses.dataclass
 class Replica:
-    """One serving replica as the registry sees it."""
+    """One replica as the registry sees it."""
 
     task: str
     endpoint: Optional[str] = None
     state: str = PENDING
+    # Which request path this replica can serve ("generate" for
+    # /v1/generate, "rank" for /v1/rank) — set from the KV suffix it
+    # advertised under.
+    kind: str = KIND_GENERATE
     # Load signals from the last probe (the /healthz payload carries the
     # scheduler occupancy) plus the router's own in-flight count — the
     # between-polls correction that keeps least-loaded from dogpiling.
@@ -103,6 +118,7 @@ class Replica:
             "task": self.task,
             "endpoint": self.endpoint,
             "state": self.state,
+            "kind": self.kind,
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
             "inflight": self.inflight,
@@ -116,8 +132,10 @@ class ReplicaRegistry:
     """Maintains the live replica set (module docstring).
 
     ``tasks=None`` discovers replicas by scanning KV keys for
-    ``*/serving_endpoint``; a launcher passes the cluster's serving
-    tasks explicitly. ``dead_heartbeat_s=None`` disables the heartbeat
+    ``*/serving_endpoint`` and ``*/rank_endpoint``; a launcher passes
+    the cluster's serving + rank tasks explicitly (their kind is then
+    resolved from whichever endpoint key each task publishes).
+    ``dead_heartbeat_s=None`` disables the heartbeat
     check (probes still govern health). ``probe_interval_s`` bounds
     probe traffic per replica; ``refresh(force=True)`` probes
     regardless (used right after an observed failure).
@@ -150,20 +168,29 @@ class ReplicaRegistry:
     def refresh(self, force: bool = False) -> List[Replica]:
         """One discovery + health pass; returns the healthy set."""
         with self._lock:
-            for task in self._discover_tasks():
-                self._replicas.setdefault(task, Replica(task))
+            for task, kind in self._discover_tasks().items():
+                replica = self._replicas.setdefault(task, Replica(task))
+                if kind is not None:
+                    replica.kind = kind
             for replica in self._replicas.values():
                 self._refresh_one(replica, force)
             healthy = self._healthy_locked()
             self._registry.gauge("fleet/healthy_replicas").set(len(healthy))
             return healthy
 
-    def _discover_tasks(self) -> List[str]:
+    def _discover_tasks(self) -> Dict[str, Optional[str]]:
+        """Task -> kind map of advertised replicas. Kind is ``None``
+        (unknown, resolved at refresh from whichever endpoint key the
+        task published) for an explicit ``tasks=`` list; the KV scan
+        path learns it from the matching suffix directly."""
         from tf_yarn_tpu import event
 
         if self._tasks is not None:
-            return self._tasks
-        suffix = f"/{event.SERVING_ENDPOINT}"
+            return {task: None for task in self._tasks}
+        suffixes = {
+            f"/{event.SERVING_ENDPOINT}": KIND_GENERATE,
+            f"/{event.RANK_ENDPOINT}": KIND_RANK,
+        }
         try:
             keys = self._kv.keys("")
         except Exception:
@@ -171,18 +198,37 @@ class ReplicaRegistry:
                 "registry KV key scan failed; keeping known replicas",
                 exc_info=True,
             )
-            return list(self._replicas)
-        return sorted(
-            {key[: -len(suffix)] for key in keys if key.endswith(suffix)}
-        )
+            return {task: None for task in self._replicas}
+        found: Dict[str, Optional[str]] = {}
+        for key in keys:
+            for suffix, kind in suffixes.items():
+                if key.endswith(suffix):
+                    found[key[: -len(suffix)]] = kind
+        return dict(sorted(found.items()))
 
     def _refresh_one(self, replica: Replica, force: bool) -> None:
         from tf_yarn_tpu import event
 
         try:
-            endpoint = self._kv.get_str(
-                f"{replica.task}/{event.SERVING_ENDPOINT}"
+            # Read the endpoint from the replica's own kind's key; when
+            # the kind is not yet known (explicit tasks= list), whichever
+            # key the task published resolves it.
+            primary = (
+                event.RANK_ENDPOINT if replica.kind == KIND_RANK
+                else event.SERVING_ENDPOINT
             )
+            fallback = (
+                event.SERVING_ENDPOINT if replica.kind == KIND_RANK
+                else event.RANK_ENDPOINT
+            )
+            endpoint = self._kv.get_str(f"{replica.task}/{primary}")
+            if endpoint is None:
+                endpoint = self._kv.get_str(f"{replica.task}/{fallback}")
+                if endpoint is not None:
+                    replica.kind = (
+                        KIND_GENERATE if replica.kind == KIND_RANK
+                        else KIND_RANK
+                    )
             stopped = (
                 self._kv.get_str(
                     f"{replica.task}/{event.HEARTBEAT_STOPPED}"
@@ -297,15 +343,24 @@ class ReplicaRegistry:
 
     # -- views --------------------------------------------------------------
 
-    def _healthy_locked(self) -> List[Replica]:
+    def _healthy_locked(
+        self, kind: Optional[str] = None
+    ) -> List[Replica]:
         return sorted(
-            (r for r in self._replicas.values() if r.state == HEALTHY),
+            (
+                r for r in self._replicas.values()
+                if r.state == HEALTHY
+                and (kind is None or r.kind == kind)
+            ),
             key=lambda r: r.task,
         )
 
-    def healthy(self) -> List[Replica]:
+    def healthy(self, kind: Optional[str] = None) -> List[Replica]:
+        """The routable set, optionally restricted to one capability
+        kind — the router passes the kind its request path demands, so
+        a /v1/rank request can never land on a generate replica."""
         with self._lock:
-            return self._healthy_locked()
+            return self._healthy_locked(kind)
 
     def get(self, task: str) -> Optional[Replica]:
         with self._lock:

@@ -7,10 +7,19 @@ keeps the single-machine-shaped API — the router exposes the IDENTICAL
 placement, and failover behind it. The per-replica serving stack is
 untouched; only the replica axis scales.
 
+Mixed fleets: the router also fronts ranking replicas
+(ranking/server.py) on the same port — dispatch is PATH-AWARE. The
+request path names the capability (``/v1/generate`` -> generate
+replicas, ``/v1/rank`` -> rank replicas, as declared by the KV suffix
+each replica advertised under), and the policy only ever picks from
+``registry.healthy(kind=...)`` — a rank request cannot land on a
+generate replica or vice versa, even when both kinds share the fleet.
+
 Same stdlib threaded-server shape as the replica frontend. Per request:
 
-1. pick a healthy replica via the configured policy (round-robin or
-   least-loaded over cached ``/healthz`` occupancy);
+1. pick a healthy replica OF THE REQUEST PATH'S KIND via the
+   configured policy (round-robin or least-loaded over cached
+   ``/healthz`` occupancy);
 2. forward. Connect errors and 429s fail over to ANOTHER replica,
    budgeted through :class:`~tf_yarn_tpu.resilience.retry.RetryPolicy`
    (per-kind budgets + decorrelated jitter; an upstream ``Retry-After``
@@ -31,8 +40,9 @@ through verbatim — retrying a user error elsewhere just reproduces it,
 the FATAL_USER posture of the failure taxonomy.
 
 `run_router` is the ``router`` task body (tasks/router.py): build the
-registry over the cluster's serving tasks, refresh it on a poll loop,
-advertise ``{task}/router_endpoint``, serve until preemption/duration.
+registry over the cluster's serving and rank tasks, refresh it on a
+poll loop, advertise ``{task}/router_endpoint``, serve until
+preemption/duration.
 """
 
 from __future__ import annotations
@@ -47,7 +57,12 @@ from typing import Dict, Optional
 
 from tf_yarn_tpu import telemetry
 from tf_yarn_tpu.fleet.policy import make_policy
-from tf_yarn_tpu.fleet.registry import Replica, ReplicaRegistry
+from tf_yarn_tpu.fleet.registry import (
+    KIND_GENERATE,
+    KIND_RANK,
+    Replica,
+    ReplicaRegistry,
+)
 from tf_yarn_tpu.resilience.retry import RetryPolicy
 from tf_yarn_tpu.resilience.taxonomy import FailureKind, classify_exception
 
@@ -60,6 +75,14 @@ MAX_FAILOVER_SLEEP_S = 5.0
 # How long the router poll loop sleeps between registry refreshes; the
 # refresh itself rate-limits per-replica probes by probe_interval_s.
 POLL_S = 0.2
+
+# Request path -> replica capability kind. The path IS the dispatch
+# key: anything else 404s, and the policy only sees replicas whose
+# advertised kind matches.
+PATH_KINDS = {
+    "/v1/generate": KIND_GENERATE,
+    "/v1/rank": KIND_RANK,
+}
 
 
 class _UpstreamUnreachable(Exception):
@@ -210,12 +233,18 @@ def _make_handler(router: RouterServer):
             if self.path == "/healthz":
                 from tf_yarn_tpu import preemption
 
-                healthy = len(router.registry.healthy())
+                healthy = router.registry.healthy()
                 draining = preemption.requested()
+                by_kind: Dict[str, int] = {}
+                for replica in healthy:
+                    by_kind[replica.kind] = by_kind.get(
+                        replica.kind, 0
+                    ) + 1
                 self._json(200, {
                     "status": "draining" if draining else "ok",
                     "role": "router",
-                    "healthy_replicas": healthy,
+                    "healthy_replicas": len(healthy),
+                    "healthy_by_kind": by_kind,
                 })
             elif self.path == "/stats":
                 self._json(200, router.stats())
@@ -223,7 +252,8 @@ def _make_handler(router: RouterServer):
                 self._json(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
-            if self.path != "/v1/generate":
+            kind = PATH_KINDS.get(self.path)
+            if kind is None:
                 self._json(404, {"error": f"unknown path {self.path}"})
                 return
             try:
@@ -235,13 +265,14 @@ def _make_handler(router: RouterServer):
                 return
             stream = bool(body.get("stream"))
             try:
-                self._route(raw_body, stream)
+                self._route(raw_body, stream, self.path, kind)
             except (BrokenPipeError, ConnectionResetError):
                 _logger.info("client dropped routed request")
 
         # -- the routing loop --------------------------------------------
 
-        def _route(self, raw_body: bytes, stream: bool) -> None:
+        def _route(self, raw_body: bytes, stream: bool,
+                   path: str, kind: str) -> None:
             # Per-request failover budget: connect errors and 429s each
             # consume from their kind's budget; deterministic jitter per
             # request sequence number.
@@ -253,22 +284,23 @@ def _make_handler(router: RouterServer):
             last_error = "no healthy replica"
             while True:
                 replica = router.policy.pick(
-                    router.registry.healthy(), exclude=tried
+                    router.registry.healthy(kind=kind), exclude=tried
                 )
                 if replica is None:
                     if not tried:
                         # Maybe the view is just stale (all ejected, or
                         # never refreshed): one forced pass before 503.
-                        if router.registry.refresh(force=True):
+                        router.registry.refresh(force=True)
+                        if router.registry.healthy(kind=kind):
                             continue
-                        self._no_replica(busy_hint, last_error)
+                        self._no_replica(busy_hint, last_error, kind)
                         return
                     # Every healthy replica tried this pass: another
                     # round costs one TRANSIENT retry, backing off with
                     # jitter but never below the upstream Retry-After.
                     delay = retry_policy.next_delay(FailureKind.TRANSIENT)
                     if delay is None:
-                        self._no_replica(busy_hint, last_error)
+                        self._no_replica(busy_hint, last_error, kind)
                         return
                     time.sleep(
                         min(max(delay, busy_hint), MAX_FAILOVER_SLEEP_S)
@@ -277,15 +309,17 @@ def _make_handler(router: RouterServer):
                     router.registry.refresh(force=True)
                     continue
                 try:
-                    outcome = self._forward(replica, raw_body, stream)
+                    outcome = self._forward(
+                        replica, raw_body, stream, path
+                    )
                 except _UpstreamUnreachable as exc:
                     router._count(replica.task, "connect_error")
                     router.registry.report_failure(replica.task, exc.cause)
                     tried.add(replica.task)
                     last_error = str(exc)
-                    kind = classify_exception(exc.cause)
-                    if retry_policy.next_delay(kind) is None:
-                        self._no_replica(busy_hint, last_error)
+                    failure_kind = classify_exception(exc.cause)
+                    if retry_policy.next_delay(failure_kind) is None:
+                        self._no_replica(busy_hint, last_error, kind)
                         return
                     continue  # fail over immediately: different replica
                 except _UpstreamBusy as exc:
@@ -298,13 +332,14 @@ def _make_handler(router: RouterServer):
                     if retry_policy.next_delay(
                         FailureKind.TRANSIENT
                     ) is None:
-                        self._no_replica(busy_hint, last_error)
+                        self._no_replica(busy_hint, last_error, kind)
                         return
                     continue
                 _logger.debug("routed request: %s", outcome)
                 return
 
-        def _no_replica(self, busy_hint: float, last_error: str) -> None:
+        def _no_replica(self, busy_hint: float, last_error: str,
+                        kind: str) -> None:
             # Counted BEFORE the response bytes go out: /stats read right
             # after a reply must already include it.
             router._count("-", "no_replica")
@@ -313,7 +348,7 @@ def _make_handler(router: RouterServer):
                 503,
                 {
                     "error": (
-                        "no serving replica available: "
+                        f"no {kind} replica available: "
                         f"{last_error}; retry in ~{retry_after:.1f}s"
                     ),
                     "retry_after_s": retry_after,
@@ -323,7 +358,7 @@ def _make_handler(router: RouterServer):
             )
 
         def _forward(self, replica: Replica, raw_body: bytes,
-                     stream: bool) -> str:
+                     stream: bool, path: str) -> str:
             host, _, port = (replica.endpoint or "").rpartition(":")
             conn = http.client.HTTPConnection(
                 host, int(port), timeout=router.upstream_timeout_s
@@ -332,7 +367,7 @@ def _make_handler(router: RouterServer):
             try:
                 try:
                     conn.request(
-                        "POST", "/v1/generate", raw_body,
+                        "POST", path, raw_body,
                         {"Content-Type": "application/json"},
                     )
                     resp = conn.getresponse()
@@ -430,8 +465,8 @@ def _make_handler(router: RouterServer):
 
 def run_router(experiment, runtime) -> dict:
     """Task body for the ``router`` task type: registry over the
-    cluster's serving tasks → policy → frontend → advertise → refresh
-    loop. Returns the final router stats snapshot."""
+    cluster's serving AND rank tasks → policy → frontend → advertise →
+    refresh loop. Returns the final router stats snapshot."""
     from tf_yarn_tpu import event, preemption
     from tf_yarn_tpu.resilience.watchdog import dead_task_secs_from_env
     from tf_yarn_tpu.serving.server import advertised_endpoint
@@ -444,7 +479,7 @@ def run_router(experiment, runtime) -> dict:
     serving_tasks = [
         instance.key.to_kv_str()
         for instance in getattr(runtime, "cluster_tasks", [])
-        if instance.key.type == "serving"
+        if instance.key.type in ("serving", "rank")
     ] or None  # None -> discover by KV scan
     registry = ReplicaRegistry(
         runtime.kv,

@@ -92,8 +92,11 @@ class DLRM(nn.Module):
         offsets = np.concatenate(
             ([0], np.cumsum(cfg.table_sizes[:-1]))
         ).astype(np.int32)
-        sizes = jnp.asarray(np.asarray(cfg.table_sizes, np.int32))
-        ids = cat % sizes[None, :] + jnp.asarray(offsets)[None, :]
+        # Kept as numpy so they enter the trace as inline constants —
+        # jnp.asarray here would emit a device_put per call, a host
+        # round-trip the analysis gate (TYA103) rejects in tick programs.
+        sizes = np.asarray(cfg.table_sizes, np.int32)
+        ids = cat % sizes[None, :] + offsets[None, :]
         emb = table[ids].astype(cfg.dtype)  # [B, F, D]
 
         feats = emb
@@ -110,8 +113,16 @@ class DLRM(nn.Module):
         # Pairwise feature interaction on the MXU; strict upper triangle
         # (self-dots excluded, symmetric pairs deduped) via static indices.
         inter = jnp.einsum("bfd,bgd->bfg", feats, feats)
-        iu, ju = np.triu_indices(feats.shape[1], k=1)
-        pairs = inter[:, iu, ju]  # [B, n_pairs]
+        n_feats = feats.shape[1]
+        iu, ju = np.triu_indices(n_feats, k=1)
+        # Flat take with a numpy index constant — the [:, iu, ju] fancy
+        # form routes the index arrays through device_put at trace time
+        # (TYA103 rejects that in tick programs); same gathered elements.
+        pairs = jnp.take(
+            inter.reshape(inter.shape[0], n_feats * n_feats),
+            (iu * n_feats + ju).astype(np.int32),
+            axis=1,
+        )  # [B, n_pairs]
 
         top = jnp.concatenate([bottom, pairs], -1) if bottom is not None else pairs
         for index, width in enumerate(self.config.top_mlp):

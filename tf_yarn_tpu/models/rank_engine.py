@@ -1,0 +1,373 @@
+"""RankEngine — compiled micro-batch ranking inference for DLRM-class models.
+
+The stateless sibling of models/decode_engine.py: ranking requests carry
+a handful of feature vectors, score in one forward, and leave nothing
+behind — no KV cache, no slots, no generation loop. What survives from
+the decode engine is the *compiled-program discipline*:
+
+* **Bucketed AOT compiles.** Incoming batches ceil-pad to a fixed grid
+  of batch buckets and run through an executable compiled once per
+  bucket (`jit(...).lower(...).compile()`), so steady-state serving
+  never traces. Padded rows are scored and discarded — row-independent
+  math keeps the real rows' scores bit-identical to an unpadded
+  forward (pinned by tests/test_ranking.py).
+* **Embedding tables model-parallel over the mesh.** A ranking model is
+  all embedding table — DLRM's stacked ``[sum(table_sizes), embed_dim]``
+  param — and a ranking replica's mesh is tp-only. The table's rows
+  shard over ``tp`` through ``parallel.sharding.RANKING_RULES`` (the
+  one-rule override of the training placement: "embed" → tp instead of
+  fsdp), dense/MLP weights replicate, and each program lowers with
+  explicit in/out shardings so XLA inserts the lookup collectives —
+  the serving twin of the reference's PS-sharded weight table
+  (SURVEY.md §2.4), with ICI collectives instead of gRPC. Still ONE
+  compiled program and one host sync per tick.
+
+TF-Replicator (PAPERS.md) in miniature: the model program is written
+single-device (`DLRM.__call__`), the topology is a placement decision.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.models.decode_engine import (
+    _ceil_bucket,
+    tree_nbytes_per_device,
+)
+
+_logger = logging.getLogger(__name__)
+
+# Ranking micro-batches skew small (latency-bound) but a loaded tick can
+# fill to max_batch; the grid covers both ends.
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def build_rank_fn(model, has_dense: bool):
+    """The ranking forward: ``(params, cat[, dense]) -> scores [B]``.
+    Module-level (not a method) so the analysis engines trace the same
+    function object serving compiles (analysis/jaxpr_engine.py
+    `models.rank_engine.*`)."""
+    if has_dense:
+        def forward(params, cat, dense):
+            return model.apply(params, cat, dense).squeeze(-1)
+    else:
+        def forward(params, cat):
+            return model.apply(params, cat).squeeze(-1)
+    return forward
+
+
+def _is_named_sharding(sharding) -> bool:
+    from jax.sharding import NamedSharding
+
+    return isinstance(sharding, NamedSharding)
+
+
+class RankEngine:
+    """Persistent compiled ranking for one model (module docstring).
+
+    Thread-safe for the compile cache; concurrent `rank` calls serialize
+    only while looking up / inserting executables — the scheduler is the
+    single ticking consumer anyway.
+    """
+
+    def __init__(
+        self,
+        model,
+        batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS,
+        mesh=None,
+    ):
+        config = getattr(model, "config", None)
+        if config is None or not hasattr(config, "table_sizes"):
+            raise ValueError(
+                "RankEngine needs a model with config.table_sizes (the "
+                "DLRM-style stacked embedding layout) — feature-arity "
+                "validation and the table sharding rule both read it"
+            )
+        self.model = model
+        self.n_tables = len(config.table_sizes)
+        self.n_dense = int(getattr(config, "n_dense", 0))
+        self.batch_buckets = tuple(sorted(set(batch_buckets)))
+        if not self.batch_buckets or self.batch_buckets[0] < 1:
+            raise ValueError(
+                f"batch_buckets must be positive, got {batch_buckets}"
+            )
+        # Embedding-sharded inference (module docstring): with a mesh,
+        # the stacked table's rows split over tp by RANKING_RULES and
+        # every program lowers with explicit in/out shardings. Config
+        # errors fail HERE with the knob's name, not as a partitioner
+        # symptom mid-trace.
+        self.mesh = mesh
+        self.tp_degree = 1
+        self._rep_sharding = None
+        self._param_shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from tf_yarn_tpu.parallel import sharding as sharding_lib
+            from tf_yarn_tpu.parallel.mesh import AXIS_TP, mesh_axis_size
+
+            self.tp_degree = int(mesh_axis_size(mesh, AXIS_TP))
+            if mesh.size != self.tp_degree:
+                raise ValueError(
+                    "ranking shards tensor-parallel only: every mesh "
+                    f"axis but '{AXIS_TP}' must be 1, got "
+                    f"{dict(zip(mesh.axis_names, mesh.devices.shape))} "
+                    "(replica parallelism is the fleet router's job)"
+                )
+            total = int(sum(config.table_sizes))
+            if total % self.tp_degree:
+                raise ValueError(
+                    f"tp={self.tp_degree} does not divide the stacked "
+                    f"embedding table's {total} rows — each device must "
+                    "hold an equal table shard; pick a tp that divides "
+                    "sum(table_sizes)"
+                )
+            self._rep_sharding = NamedSharding(mesh, PartitionSpec())
+            try:
+                abstract = self._abstract_init()
+            except Exception as exc:
+                raise ValueError(
+                    "RankEngine(mesh=...) could not abstractly init "
+                    f"{type(model).__name__} to read its logical-axis "
+                    f"annotations: {type(exc).__name__}: {exc}"
+                ) from exc
+            self._param_shardings = sharding_lib.tree_shardings(
+                mesh, abstract, rules=sharding_lib.RANKING_RULES
+            )
+        self._forward: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self.stats = {
+            "calls": 0,
+            "forward_compiles": 0,
+            "forward_cache_hits": 0,
+            "unbucketed_shapes": 0,
+        }
+
+    def _abstract_init(self):
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        cat = jax.ShapeDtypeStruct((1, self.n_tables), jnp.int32)
+        if self.n_dense:
+            dense = jax.ShapeDtypeStruct((1, self.n_dense), jnp.float32)
+            return jax.eval_shape(
+                lambda r, c, d: self.model.init(r, c, d), rng, cat, dense
+            )
+        return jax.eval_shape(
+            lambda r, c: self.model.init(r, c), rng, cat
+        )
+
+    # -- bucket selection ---------------------------------------------------
+
+    def select_bucket(self, batch: int) -> int:
+        """Padded batch size for an incoming batch of `batch` rows:
+        ceil to the bucket grid (extra rows are scored and discarded);
+        beyond the grid the exact size compiles, logged."""
+        return _ceil_bucket(batch, self.batch_buckets) or batch
+
+    def _params_fingerprint(self, params) -> int:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        return hash((treedef, tuple(
+            (tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves
+        )))
+
+    # -- tensor-parallel placement ------------------------------------------
+
+    def place_params(self, params):
+        """Param normalization for every public entry: flax Partitioned
+        boxes stripped (fresh `model.init` output ranks as-is), host
+        arrays become device arrays; under a mesh every leaf lands on
+        the placement RANKING_RULES assigns (table rows over tp,
+        dense/MLP replicated) — a no-op transfer-wise once placed."""
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+
+        params = sharding_lib.unbox_params(params)
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        if self.mesh is None:
+            return params
+
+        def _place(leaf, sharding):
+            if getattr(leaf, "sharding", None) == sharding:
+                return leaf
+            return jax.device_put(leaf, sharding)
+
+        try:
+            return jax.tree_util.tree_map(
+                _place, params, self._param_shardings
+            )
+        except ValueError as exc:
+            raise ValueError(
+                "params do not match the model's init structure — "
+                f"cannot place them on the tp mesh: {exc}"
+            ) from exc
+
+    def params_nbytes_per_device(self, params) -> int:
+        """Resident param bytes on EACH device after placement — the
+        number the ``ranking/params_hbm_bytes_per_device`` gauge and
+        the tp accounting tests read (1/tp of the table + one copy of
+        the dense stack)."""
+        return tree_nbytes_per_device(self.place_params(params))
+
+    def _shardings_of(self, tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: (
+                leaf.sharding
+                if _is_named_sharding(getattr(leaf, "sharding", None))
+                else self._rep_sharding
+            ),
+            tree,
+        )
+
+    def _jit(self, fn, args):
+        """jax.jit wired for this engine's mesh: explicit in/out
+        shardings under tensor parallelism (XLA inserts the embedding
+        gathers from these alone — replicated [B] scores out), the
+        plain single-device jit otherwise."""
+        if self.mesh is None:
+            return jax.jit(fn)
+        return jax.jit(
+            fn,
+            in_shardings=tuple(self._shardings_of(arg) for arg in args),
+            out_shardings=self._rep_sharding,
+        )
+
+    # -- compile cache ------------------------------------------------------
+
+    def _compiled(self, key, build):
+        registry = telemetry.get_registry()
+        with self._lock:
+            compiled = self._forward.get(key)
+            if compiled is not None:
+                self.stats["forward_cache_hits"] += 1
+                registry.counter(
+                    "rank_engine/cache_hits", kind="forward"
+                ).inc()
+                return compiled
+        # Compile outside the lock (slow); a racing duplicate compile is
+        # harmless — last writer wins, both executables are equivalent.
+        with telemetry.span(
+            "rank_engine/compile", kind="forward", key=str(key)
+        ) as sp:
+            compiled = build()
+        registry.counter("rank_engine/compiles", kind="forward").inc()
+        registry.histogram(
+            "rank_engine/compile_seconds", kind="forward"
+        ).observe(sp.duration)
+        with self._lock:
+            self._forward[key] = compiled
+            self.stats["forward_compiles"] += 1
+            _logger.info(
+                "rank-engine compiled forward for key=%s (%d compiles, "
+                "%d cached)", key, self.stats["forward_compiles"],
+                len(self._forward),
+            )
+        return compiled
+
+    def program_keys(self) -> Dict[str, list]:
+        """Distinct compile-cache keys per program kind — the recompile-
+        churn probe surface (analysis TYA205)."""
+        with self._lock:
+            return {"forward": sorted(self._forward)}
+
+    # -- the public tick ----------------------------------------------------
+
+    def feature_arrays(self, cat, dense):
+        """Validate + canonicalize one feature batch: int32 ``cat
+        [B, n_tables]`` and float32 ``dense [B, n_dense]`` (or None for
+        dense-free models). Raises ValueError on arity mismatch — the
+        scheduler calls this AT SUBMIT so a malformed request dies as
+        the frontend's 400, never inside the ticking loop."""
+        cat = np.asarray(cat, np.int32)
+        if cat.ndim != 2 or cat.shape[1] != self.n_tables:
+            raise ValueError(
+                f"cat must be [batch, {self.n_tables}] (one id per "
+                f"categorical table), got shape {tuple(cat.shape)}"
+            )
+        if self.n_dense:
+            if dense is None:
+                raise ValueError(
+                    f"this model takes {self.n_dense} dense features per "
+                    "row; the request carried none"
+                )
+            dense = np.asarray(dense, np.float32)
+            if dense.shape != (cat.shape[0], self.n_dense):
+                raise ValueError(
+                    f"dense must be [batch, {self.n_dense}], got shape "
+                    f"{tuple(dense.shape)}"
+                )
+        elif dense is not None:
+            raise ValueError(
+                "this model takes no dense features; the request "
+                "carried some"
+            )
+        return cat, dense
+
+    def rank(self, params, cat, dense=None) -> np.ndarray:
+        """Score a ``[B, n_tables]`` id batch (plus ``[B, n_dense]``
+        dense features when the model has them): float32 scores ``[B]``.
+
+        B ceil-pads to the bucket grid with zero rows (valid ids after
+        the model's per-table mod-fold; their scores are computed and
+        dropped), the bucketed executable runs, and the ONE host sync —
+        `np.asarray` on the scores — ends the tick.
+        """
+        self.stats["calls"] += 1
+        params = self.place_params(params)
+        cat, dense = self.feature_arrays(cat, dense)
+        batch = cat.shape[0]
+        if batch < 1:
+            raise ValueError("cannot rank an empty batch")
+        bucket = self.select_bucket(batch)
+        if bucket not in self.batch_buckets:
+            self.stats["unbucketed_shapes"] += 1
+            _logger.warning(
+                "rank batch %d beyond the bucket grid %s: exact-shape "
+                "compile", batch, self.batch_buckets,
+            )
+        if bucket != batch:
+            cat = np.concatenate(
+                [cat, np.zeros((bucket - batch, self.n_tables), np.int32)]
+            )
+            if dense is not None:
+                dense = np.concatenate(
+                    [dense,
+                     np.zeros((bucket - batch, self.n_dense), np.float32)]
+                )
+        cat_dev = jnp.asarray(cat)
+        args = (params, cat_dev)
+        if dense is not None:
+            args = args + (jnp.asarray(dense),)
+        fn = build_rank_fn(self.model, has_dense=dense is not None)
+        key = (
+            bucket, dense is not None, self._params_fingerprint(params)
+        )
+        compiled = self._compiled(
+            key, lambda: self._jit(fn, args).lower(*args).compile()
+        )
+        with telemetry.span("rank_engine/forward", batch=batch,
+                            bucket=bucket):
+            scores = compiled(*args)
+        return np.asarray(scores, np.float32)[:batch]
+
+    def warmup(self, params, max_batch: Optional[int] = None) -> int:
+        """AOT-compile every bucket ≤ `max_batch` (all of them when
+        None) with zero features, so the first real request on each
+        bucket dispatches a ready executable instead of paying the
+        compile. Returns the number of buckets warmed."""
+        warmed = 0
+        for bucket in self.batch_buckets:
+            if max_batch is not None and bucket > max_batch:
+                break
+            cat = np.zeros((bucket, self.n_tables), np.int32)
+            dense = (
+                np.zeros((bucket, self.n_dense), np.float32)
+                if self.n_dense else None
+            )
+            self.rank(params, cat, dense)
+            warmed += 1
+        return warmed

@@ -55,6 +55,18 @@ LOGICAL_RULES: Tuple[Tuple[str, Any], ...] = (
     ("stage", None),
 )
 
+# Ranking-inference placement (tf_yarn_tpu/models/rank_engine.py): the
+# stacked embedding table — annotated ("embed", None) — is the model's
+# whole memory footprint, and a ranking replica's mesh is tp-only (no
+# fsdp axis to shard it over). Overriding ONE rule moves the table's
+# rows over tp while every training placement stays untouched: the
+# serving twin of the PS-shard the reference put behind
+# ParameterServerStrategy (SURVEY.md §2.4), with XLA inserting the
+# lookup collectives instead of gRPC.
+RANKING_RULES: Tuple[Tuple[str, Any], ...] = (
+    ("embed", AXIS_TP),
+) + tuple(rule for rule in LOGICAL_RULES if rule[0] != "embed")
+
 
 def logical_to_spec(
     logical_axes: Sequence[Optional[str]], rules=LOGICAL_RULES
@@ -91,13 +103,13 @@ def infer_fsdp_partition(shape: Tuple[int, ...], fsdp_size: int) -> PartitionSpe
     return PartitionSpec(*spec)
 
 
-def _leaf_spec(leaf, fsdp_size: int) -> PartitionSpec:
+def _leaf_spec(leaf, fsdp_size: int, rules=LOGICAL_RULES) -> PartitionSpec:
     # flax `nn.with_partitioning` wraps leaves in nn.Partitioned with .names.
     names = getattr(leaf, "names", None)
     value = getattr(leaf, "value", leaf)
     shape = tuple(getattr(value, "shape", ()))
     if names is not None and len(names) == len(shape):
-        return logical_to_spec(names)
+        return logical_to_spec(names, rules)
     # Rank mismatch happens when an optimizer builds reduced-rank state
     # from boxed params (adafactor's row/col factors keep the box but drop
     # an axis) — the annotation no longer applies; infer instead.
@@ -108,19 +120,22 @@ def _is_leaf(node) -> bool:
     return hasattr(node, "names") and hasattr(node, "value")
 
 
-def tree_partition_specs(tree, fsdp_size: int):
+def tree_partition_specs(tree, fsdp_size: int, rules=LOGICAL_RULES):
     """PartitionSpec pytree matching `tree` (params, opt state, or a whole
-    TrainState); annotated leaves follow LOGICAL_RULES, the rest FSDP-infer."""
+    TrainState); annotated leaves follow `rules` (LOGICAL_RULES unless a
+    caller like the rank engine overrides them), the rest FSDP-infer."""
     return jax.tree_util.tree_map(
-        lambda leaf: _leaf_spec(leaf, fsdp_size), tree, is_leaf=_is_leaf
+        lambda leaf: _leaf_spec(leaf, fsdp_size, rules), tree,
+        is_leaf=_is_leaf,
     )
 
 
-def tree_shardings(mesh: Mesh, tree, fsdp_size: Optional[int] = None):
+def tree_shardings(mesh: Mesh, tree, fsdp_size: Optional[int] = None,
+                   rules=LOGICAL_RULES):
     """NamedSharding pytree for placing `tree` on `mesh`."""
     if fsdp_size is None:
         fsdp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_FSDP, 1)
-    specs = tree_partition_specs(tree, fsdp_size)
+    specs = tree_partition_specs(tree, fsdp_size, rules)
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
         specs,
@@ -171,10 +186,12 @@ def reshard_state(state, new_mesh: Mesh, old_spec=None, shardings=None):
     )
 
 
-def shard_like_annotated(mesh: Mesh, abstract_tree, tree):
+def shard_like_annotated(mesh: Mesh, abstract_tree, tree,
+                         rules=LOGICAL_RULES):
     """Place an UNBOXED pytree (a restored checkpoint) onto `mesh` with
     the placements the ANNOTATED abstract tree assigns through
-    LOGICAL_RULES — the restore-side twin of `tree_shardings`.
+    `rules` (LOGICAL_RULES by default; the rank engine passes
+    RANKING_RULES) — the restore-side twin of `tree_shardings`.
 
     By restore time the flax Partitioned boxes are gone from the values
     (checkpoints store raw arrays), so the logical names must come from
@@ -184,7 +201,7 @@ def shard_like_annotated(mesh: Mesh, abstract_tree, tree):
     the compiled programs expect — the same pitfall `reshard_state`
     documents. Leaves already holding their target sharding are left
     untouched (no transfer on a re-place)."""
-    shardings = tree_shardings(mesh, abstract_tree)
+    shardings = tree_shardings(mesh, abstract_tree, rules=rules)
     value_def = jax.tree_util.tree_structure(tree)
     sharding_def = jax.tree_util.tree_structure(shardings)
     if value_def != sharding_def:

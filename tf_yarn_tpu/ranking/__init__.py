@@ -1,0 +1,29 @@
+"""Stateless micro-batch ranking: the second serving workload class.
+
+Token decode (tf_yarn_tpu/serving/) is stateful — a request occupies a
+KV slot for hundreds of ticks. Ranking is the opposite regime: tiny,
+latency-bound, stateless requests that score in ONE forward and free
+their capacity the same tick. The subsystem shares the serving stack's
+bones (AdmissionQueue backpressure, deadline semantics, the HTTP
+conventions, KV-event discovery, the fleet router) but none of its KV
+machinery — no block pool, no prefix cache, no slots.
+
+docs/Ranking.md is the operator guide.
+"""
+
+from tf_yarn_tpu.ranking.scheduler import (
+    FINISH_COMPLETE,
+    MicroBatchScheduler,
+    RankRequest,
+    RankResponse,
+)
+from tf_yarn_tpu.ranking.server import RankServer, run_ranking
+
+__all__ = [
+    "FINISH_COMPLETE",
+    "MicroBatchScheduler",
+    "RankRequest",
+    "RankResponse",
+    "RankServer",
+    "run_ranking",
+]

@@ -1,0 +1,289 @@
+"""Threaded HTTP JSON frontend + the `rank` task body.
+
+Same stdlib-only conventions as serving/server.py (the TPU VM image
+carries no web framework), different protocol:
+
+* ``POST /v1/rank`` — body ``{"cat": [[ids...]], "dense": [[f...]],
+  "priority": P, "timeout_s": T}``: one int id per categorical table
+  and one float per dense feature, per row. Reply ``{"scores": [...],
+  "request_id", "finish_reason"}`` — one float32 score per row, in row
+  order. Wrong feature arity (or a batch beyond ``max_batch``) answers
+  400 AT ADMISSION; a full admission queue answers 429 with
+  ``Retry-After`` (backpressure, not buffering).
+* ``GET /healthz`` — liveness; reports "draining" the instant a
+  preemption notice lands (same registry-ejection contract as serving).
+* ``GET /stats`` — scheduler snapshot + rank-engine compile stats.
+
+`run_ranking` is the task program body (tasks/rank.py): params from a
+checkpoint (or a seeded init for checkpointless demos), the shared
+RankEngine — embedding-sharded over the replica's tp mesh when one is
+configured — the micro-batch scheduler loop, the frontend, and the
+``rank_endpoint`` KV advertisement the fleet router discovers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.ranking.scheduler import MicroBatchScheduler
+from tf_yarn_tpu.serving.request import QueueFull
+
+_logger = logging.getLogger(__name__)
+
+
+class RankServer:
+    """The HTTP frontend over one MicroBatchScheduler; per-connection
+    threaded so a slow client never blocks admissions."""
+
+    def __init__(self, scheduler: MicroBatchScheduler,
+                 host: str = "127.0.0.1", port: int = 0):
+        handler = _make_handler(scheduler)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.scheduler = scheduler
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"{host}:{self.port}"
+
+    def start(self) -> str:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ranking-http",
+            daemon=True,
+        )
+        self._thread.start()
+        _logger.info("ranking frontend listening on %s", self.endpoint)
+        return self.endpoint
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def _make_handler(scheduler: MicroBatchScheduler):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            _logger.debug("http %s", fmt % args)
+
+        def _json(self, status: int, payload: dict, headers=()) -> None:
+            body = (json.dumps(payload) + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in headers:
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                from tf_yarn_tpu import preemption
+
+                snap = scheduler.stats()
+                # Same race-closure as serving's /healthz: consult the
+                # preemption flag directly, not just the drain flag the
+                # task loop sets on its next poll, so the router ejects
+                # this replica the instant the notice lands.
+                draining = bool(
+                    snap.get("draining")
+                ) or preemption.requested()
+                self._json(200, {
+                    "status": "draining" if draining else "ok",
+                    "queue_depth": snap["queue_depth"],
+                    "queued_rows": snap["queued_rows"],
+                })
+            elif self.path == "/stats":
+                self._json(200, scheduler.stats())
+            else:
+                self._json(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/rank":
+                self._json(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                cat = body["cat"]
+                dense = body.get("dense")
+                priority = int(body.get("priority", 0))
+                timeout_s = body.get("timeout_s")
+            except (KeyError, TypeError, ValueError) as exc:
+                self._json(400, {"error": f"bad request: {exc}"})
+                return
+            try:
+                response = scheduler.submit(
+                    cat, dense, priority=priority, timeout_s=timeout_s
+                )
+            except QueueFull as exc:
+                self._json(
+                    429,
+                    {"error": str(exc), "retry_after_s": exc.retry_after_s},
+                    headers=(("Retry-After",
+                              str(max(1, int(exc.retry_after_s)))),),
+                )
+                return
+            except (TypeError, ValueError) as exc:
+                # Feature-arity (and any malformed-array) rejection at
+                # admission — the scheduler loop never sees the request.
+                self._json(400, {"error": str(exc)})
+                return
+            wait = timeout_s + 5.0 if timeout_s else None
+            try:
+                scores = response.result(timeout=wait)
+            except TimeoutError as exc:
+                self._json(504, {"error": str(exc)})
+                return
+            self._json(200, {
+                "scores": scores,
+                "finish_reason": response.finish_reason,
+                "request_id": response.request.id,
+            })
+
+    return Handler
+
+
+def run_ranking(experiment, runtime=None) -> dict:
+    """Task body for a RankingExperiment: params → engine → scheduler →
+    frontend → advertise → serve. Returns the final stats snapshot."""
+    import jax
+
+    from tf_yarn_tpu import event, fs as fs_lib, preemption
+    from tf_yarn_tpu.models.rank_engine import (
+        DEFAULT_BATCH_BUCKETS,
+        RankEngine,
+    )
+    from tf_yarn_tpu.parallel import sharding as sharding_lib
+    from tf_yarn_tpu.serving.server import advertised_endpoint
+
+    telemetry_task = "rank"
+    if runtime is not None:
+        telemetry_task = getattr(
+            runtime, "task",
+            f"{runtime.task_key.type}:{runtime.task_key.id}",
+        )
+    telemetry.enable_env_jsonl(telemetry_task)
+    # Mesh BEFORE params, same reason as serving: a device shortfall
+    # fails in milliseconds, not after the restore.
+    mesh = None
+    mesh_spec = getattr(experiment, "mesh_spec", None)
+    if mesh_spec is not None and mesh_spec.total_devices > 1:
+        from tf_yarn_tpu.parallel import mesh as mesh_lib
+
+        with telemetry.span("ranking/build_mesh",
+                            devices=mesh_spec.total_devices):
+            mesh = mesh_lib.build_mesh(
+                mesh_spec,
+                mesh_lib.select_devices(mesh_spec.total_devices),
+            )
+        _logger.info(
+            "ranking tensor-parallel: tp=%d over %d devices",
+            mesh_spec.tp, mesh_spec.total_devices,
+        )
+    if experiment.model_dir is not None:
+        from tf_yarn_tpu import inference
+
+        fs_lib.check_model_dir_placement(experiment.model_dir)
+        with telemetry.span("ranking/restore_params"):
+            params, step = inference._restore_params(
+                experiment.model_dir, experiment.step
+            )
+    else:
+        # Checkpointless path (demos, the e2e tests): a deterministic
+        # seeded init — any peer running the same model + seed computes
+        # bit-identical params, which is what lets the e2e compare
+        # served scores against a direct local forward.
+        import jax.numpy as jnp
+
+        cfg = experiment.model.config
+        with telemetry.span("ranking/init_params",
+                            seed=experiment.init_seed):
+            cat = jnp.zeros((1, len(cfg.table_sizes)), jnp.int32)
+            dense = (
+                jnp.zeros((1, cfg.n_dense), jnp.float32)
+                if cfg.n_dense else None
+            )
+            args = (cat,) if dense is None else (cat, dense)
+            params = sharding_lib.unbox_params(experiment.model.init(
+                jax.random.PRNGKey(experiment.init_seed), *args
+            ))
+        step = -1
+    engine = RankEngine(
+        experiment.model,
+        batch_buckets=experiment.batch_buckets or DEFAULT_BATCH_BUCKETS,
+        mesh=mesh,
+    )
+    scheduler = MicroBatchScheduler(
+        engine,
+        params,
+        max_batch=experiment.max_batch,
+        max_wait_ms=experiment.max_wait_ms,
+        queue_capacity=experiment.queue_capacity,
+        retry_after_s=experiment.retry_after_s,
+    )
+    if experiment.warmup:
+        with telemetry.span("ranking/warmup"):
+            warmed = engine.warmup(
+                scheduler.params, max_batch=experiment.max_batch
+            )
+        _logger.info("ranking warmup compiled %d buckets", warmed)
+    server = RankServer(scheduler, experiment.host, experiment.port)
+    scheduler.start()
+    endpoint = server.start()
+    advertised = advertised_endpoint(experiment.host, server.port)
+    if runtime is not None:
+        event.rank_endpoint_event(runtime.kv, runtime.task, advertised)
+    _logger.info(
+        "ranking ckpt-%d on %s (advertised %s): max_batch=%d, "
+        "max_wait_ms=%.1f, queue=%d",
+        step, endpoint, advertised, experiment.max_batch,
+        experiment.max_wait_ms, experiment.queue_capacity,
+    )
+
+    deadline = (
+        time.monotonic() + experiment.serve_seconds
+        if experiment.serve_seconds is not None else None
+    )
+    try:
+        while True:
+            if preemption.requested():
+                _logger.info("ranking task draining on preemption notice")
+                scheduler.drain()
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                _logger.info(
+                    "serve_seconds=%.1f elapsed; shutting down",
+                    experiment.serve_seconds,
+                )
+                break
+            time.sleep(0.2)
+    finally:
+        server.stop()
+        scheduler.close()
+        stats = {"endpoint": advertised, "ckpt_step": step,
+                 **scheduler.stats()}
+        _logger.info("ranking done: %s", stats)
+        telemetry.flush_metrics(
+            telemetry.get_registry(),
+            kv=getattr(runtime, "kv", None),
+            task=telemetry_task if runtime is not None else None,
+        )
+        telemetry.export_trace(telemetry_task)
+    return stats

@@ -181,6 +181,11 @@ class AdmissionQueue:
     the frontend turns into an HTTP Retry-After header.
     """
 
+    # The Response built per admission. Subclass hook: the ranking
+    # queue (ranking/scheduler.py) swaps in a float-score Response while
+    # reusing this class's bound/priority/backpressure behavior intact.
+    response_cls = Response
+
     def __init__(self, capacity: int = 64, retry_after_s: float = 1.0):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -191,7 +196,7 @@ class AdmissionQueue:
         self._seq = itertools.count()
 
     def submit(self, request: Request) -> Response:
-        response = Response(request)
+        response = self.response_cls(request)
         with self._lock:
             if len(self._heap) >= self.capacity:
                 raise QueueFull(len(self._heap), self.retry_after_s)

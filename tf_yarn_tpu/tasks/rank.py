@@ -1,0 +1,71 @@
+"""Task program for the ``rank`` task type.
+
+The stateless micro-batch sibling of tasks/serving.py: bootstrap, pull
+the RankingExperiment from the KV store, and run the ranking server
+(`tf_yarn_tpu.ranking.server.run_ranking`) under the same lifecycle
+events, heartbeats, and failure classification — a crashed ranking
+replica is classified through its stop event and relaunched by the
+driver's RetryPolicy, and the heartbeat watchdog turns a
+wedged-but-alive server into a LOST_TASK within one poll.
+
+SIGTERM (the TPU-VM preemption notice) sets the drain flag
+`run_ranking` polls: `/healthz` flips to "draining" the instant the
+notice lands (the fleet router ejects the replica), queued requests
+finish as ``shutdown``, and the task exits cleanly.
+
+A ``RankingExperiment(mesh_spec=MeshSpec(tp=N))`` makes this replica
+EMBEDDING-SHARDED (docs/Ranking.md "Sharding layout"): `run_ranking`
+builds the mesh over the task's N devices before any params load, then
+places the stacked embedding table 1/N per device.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event, telemetry
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def _run(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    from tf_yarn_tpu import experiment as experiment_mod
+
+    if not isinstance(experiment, experiment_mod.RankingExperiment):
+        raise TypeError(
+            f"rank tasks expect a RankingExperiment, got "
+            f"{type(experiment)!r}"
+        )
+    experiment_mod.run_experiment(runtime, experiment)
+
+
+def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    preemption.install()
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        # MonitoredThread so the captured exception carries the ranking
+        # stack into the stop event (classification reads it there).
+        thread = MonitoredThread(
+            target=_run,
+            args=(runtime, experiment),
+            name=f"rank-{runtime.task}",
+        )
+        with telemetry.Heartbeat(
+            runtime.kv, runtime.task,
+            every=telemetry.heartbeat.every_from_env(),
+            registry=telemetry.get_registry(),
+        ):
+            thread.start()
+            thread.join()
+        if thread.exception is not None:
+            raise thread.exception
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ MAX_CHIPS_PER_HOST = 8
 
 ALL_TASK_TYPES = {
     "chief", "worker", "evaluator", "tensorboard", "serving", "router",
+    "rank",
 }
 
 # Known slice shapes: name -> (total chips, hosts). Used by
@@ -155,10 +156,10 @@ def _check_general_topology(task_specs: TaskSpecs) -> None:
         raise ValueError("at most one chief is allowed")
     if not any(
         t in task_specs and task_specs[t].instances > 0
-        for t in ("chief", "worker", "serving")
+        for t in ("chief", "worker", "serving", "rank")
     ):
         raise ValueError(
-            "need at least one chief, worker, or serving instance"
+            "need at least one chief, worker, serving, or rank instance"
         )
     for task_type in ("evaluator", "tensorboard"):
         if task_type in task_specs and task_specs[task_type].instances > 1:
@@ -171,14 +172,16 @@ def _check_general_topology(task_specs: TaskSpecs) -> None:
             raise ValueError(
                 "router is a CPU frontend; it cannot reserve chips"
             )
-        n_serving = (
-            task_specs["serving"].instances if "serving" in task_specs else 0
+        n_upstream = sum(
+            task_specs[t].instances
+            for t in ("serving", "rank") if t in task_specs
         )
-        if router.instances > 0 and n_serving < 1:
+        if router.instances > 0 and n_upstream < 1:
             raise ValueError(
-                "a router task needs at least one serving replica to route "
-                "to — add a 'serving' spec with instances >= 1 "
-                "(topologies.fleet_topology builds the pair)"
+                "a router task needs at least one serving or rank replica "
+                "to route to — add a 'serving' or 'rank' spec with "
+                "instances >= 1 (topologies.fleet_topology / "
+                "mixed_fleet_topology build the pairs)"
             )
 
 
@@ -298,6 +301,74 @@ def fleet_topology(
         vcores=vcores,
         chips_per_host=chips_per_host,
     )
+    specs["router"] = TaskSpec(
+        memory_gib=router_memory_gib,
+        vcores=router_vcores,
+        instances=1,
+        label=NodeLabel.CPU,
+    )
+    check_topology(specs)
+    return specs
+
+
+def ranking_topology(
+    instances: int = 1,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    chips_per_host: int = 1,
+) -> TaskSpecs:
+    """`instances` independent online-ranking replicas
+    (tf_yarn_tpu.ranking; docs/Ranking.md). Same share-nothing shape as
+    `serving_topology`, different workload class: each replica loads
+    the model (embedding-sharded over its own local chips when
+    chips_per_host > 1), ticks its micro-batch loop, and advertises a
+    ``rank_endpoint`` through the KV store."""
+    if instances < 1:
+        raise ValueError(f"instances must be >= 1, got {instances}")
+    specs: TaskSpecs = {
+        "rank": TaskSpec(
+            memory_gib=memory_gib,
+            vcores=vcores,
+            instances=instances,
+            chips_per_host=chips_per_host,
+            label=NodeLabel.TPU if chips_per_host else NodeLabel.CPU,
+        )
+    }
+    check_topology(specs)
+    return specs
+
+
+def mixed_fleet_topology(
+    nb_serving: int = 1,
+    nb_rank: int = 1,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    chips_per_host: int = 1,
+    router_memory_gib: int = 8,
+    router_vcores: int = 4,
+) -> TaskSpecs:
+    """A mixed fleet: ONE router frontend dispatching by path —
+    ``/v1/generate`` to token-decode replicas, ``/v1/rank`` to ranking
+    replicas (docs/Fleet.md "Path-aware dispatch"). The registry knows
+    each replica's capability from which KV key it advertised, so a
+    rank request can never land on a generate replica."""
+    if nb_serving < 1 or nb_rank < 1:
+        raise ValueError(
+            f"need at least one replica of each kind, got "
+            f"nb_serving={nb_serving}, nb_rank={nb_rank}"
+        )
+    specs = serving_topology(
+        instances=nb_serving,
+        memory_gib=memory_gib,
+        vcores=vcores,
+        chips_per_host=chips_per_host,
+    )
+    specs.update(ranking_topology(
+        instances=nb_rank,
+        memory_gib=memory_gib,
+        vcores=vcores,
+        chips_per_host=chips_per_host,
+    ))
     specs["router"] = TaskSpec(
         memory_gib=router_memory_gib,
         vcores=router_vcores,

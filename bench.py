@@ -398,6 +398,19 @@ def _stale_tpu_fields() -> dict:
         fields["last_tpu_serve_tp_kv_per_device_ratio"] = tp_ab[
             "kv_per_device_ratio"
         ]
+    chunked_ab = serve.get("chunked") or {}
+    for row_name, row in (chunked_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "itl_p95_ms" in row:
+            fields[f"last_tpu_serve_chunked_{row_name}_itl_p95_ms"] = row[
+                "itl_p95_ms"
+            ]
+            fields[f"last_tpu_serve_chunked_{row_name}_ttft_p95_ms"] = (
+                row.get("ttft_p95_ms")
+            )
+    if "itl_p95_ratio" in chunked_ab:
+        fields["last_tpu_serve_chunked_itl_p95_ratio"] = chunked_ab[
+            "itl_p95_ratio"
+        ]
     fleet = table.get("fleet") or {}
     for row_name, row in (fleet.get("rows") or {}).items():
         if isinstance(row, dict) and "tokens_per_sec" in row:
@@ -661,7 +674,7 @@ def bench_flagship_train():
         except Exception as exc:
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
         try:
-            serve = suite.bench_serve(tpu=True, tp=True)
+            serve = suite.bench_serve(tpu=True, tp=True, chunked=True)
             ab["serve"] = serve
             _write_ab(ab)
             # Online-serving headline pair: continuous-batching
@@ -717,6 +730,22 @@ def bench_flagship_train():
             if "kv_per_device_ratio" in tp_ab:
                 result["serve_tp_kv_per_device_ratio"] = tp_ab[
                     "kv_per_device_ratio"
+                ]
+            # Chunked-prefill A/B: blocking vs chunked admission on the
+            # bimodal trace — inter-token-latency p95 is the no-stall
+            # claim (TTFT p95 rides along), streams must match.
+            chunked_ab = serve.get("chunked") or {}
+            for row_name, row in (chunked_ab.get("rows") or {}).items():
+                if isinstance(row, dict) and "itl_p95_ms" in row:
+                    result[f"serve_chunked_{row_name}_itl_p95_ms"] = row[
+                        "itl_p95_ms"
+                    ]
+                    result[f"serve_chunked_{row_name}_ttft_p95_ms"] = (
+                        row.get("ttft_p95_ms")
+                    )
+            if "itl_p95_ratio" in chunked_ab:
+                result["serve_chunked_itl_p95_ratio"] = chunked_ab[
+                    "itl_p95_ratio"
                 ]
             _log(f"serve: {serve}")
         except Exception as exc:
@@ -793,7 +822,7 @@ def _record_cpu_serve_ab(result: dict) -> None:
     line."""
     try:
         suite = _load_bench_suite()
-        serve = suite.bench_serve(tpu=False, tp=True)
+        serve = suite.bench_serve(tpu=False, tp=True, chunked=True)
     except Exception as exc:  # the bench headline must still print
         _log(f"cpu serve bench FAILED: {type(exc).__name__}: {exc}")
         return
@@ -834,6 +863,25 @@ def _record_cpu_serve_ab(result: dict) -> None:
         result["serve_cpu_tp_kv_per_device_ratio"] = tp_ab[
             "kv_per_device_ratio"
         ]
+    # Chunked-prefill A/B: the bit-identity flag is a scheduling
+    # property and holds anywhere; the ITL ratio is device-shaped (the
+    # section's note explains why the CPU number is not the claim).
+    chunked_ab = serve.get("chunked") or {}
+    for row_name, row in (chunked_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "itl_p95_ms" in row:
+            result[f"serve_cpu_chunked_{row_name}_itl_p95_ms"] = row[
+                "itl_p95_ms"
+            ]
+    if "itl_p95_ratio" in chunked_ab:
+        result["serve_cpu_chunked_itl_p95_ratio"] = chunked_ab[
+            "itl_p95_ratio"
+        ]
+    if "streams_match_blocking" in (
+        (chunked_ab.get("rows") or {}).get("chunked") or {}
+    ):
+        result["serve_cpu_chunked_streams_match_blocking"] = chunked_ab[
+            "rows"
+        ]["chunked"]["streams_match_blocking"]
     try:
         with open(_AB_PATH) as fh:
             table = json.load(fh)

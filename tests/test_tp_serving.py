@@ -286,6 +286,39 @@ def test_tp_spec_decode_matches_legacy():
         scheduler.close()
 
 
+@pytest.mark.slow
+def test_tp_chunked_prefill_matches_legacy():
+    """tp=2 + chunked prefill (paged): admission replays the prompt in
+    teacher-forced windows through the sharded program — the stream
+    still equals single-device generate_legacy, and a repeat of the
+    prompt admits through the incrementally registered prefix blocks."""
+    model, params, engine, scheduler = _tiny_stack(
+        mesh=_mesh(), kv_layout="paged", block_size=8, num_blocks=17,
+        prefill_chunk=4, prefill_budget_per_tick=8,
+    )
+    scheduler.start()
+    try:
+        from tf_yarn_tpu.serving import SamplingParams
+
+        prompt = np.random.RandomState(3).randint(
+            0, 256, (17,)
+        ).tolist()
+        expected = _legacy_stream(model, params, prompt, 8)
+        out = scheduler.submit(
+            prompt, SamplingParams(max_new_tokens=8)
+        ).result(timeout=300)
+        assert out == expected
+        repeat = scheduler.submit(
+            prompt, SamplingParams(max_new_tokens=8)
+        ).result(timeout=300)
+        assert repeat == expected
+        stats = scheduler.stats()
+        assert stats["prefill_chunk"] == 4
+        assert stats["prefix_cache"]["hits"] >= 1
+    finally:
+        scheduler.close()
+
+
 def test_run_serving_with_mesh_spec_serves_sharded_e2e(monkeypatch):
     """The full task body with mesh_spec=MeshSpec(tp=2): mesh built,
     restore SHARDED by the logical rules (inference.
@@ -466,6 +499,7 @@ def test_tp_step_program_has_allreduce_and_no_host_callbacks():
     assert set(entries) == {
         "models.decode_engine.sharded_step",
         "models.decode_engine.sharded_paged_step",
+        "models.decode_engine.sharded_chunk_apply",
     }
     for entry in entries.values():
         findings, counts = check_entry(entry)

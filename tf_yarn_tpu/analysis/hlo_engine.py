@@ -692,6 +692,14 @@ def default_entries() -> List[HloEntry]:
             "models.decode_engine.paged_spec_step",
             Manifest(collectives={}, donate_argnums=(1, 7)),
         ),
+        # The chunk-apply (the windowed program at the chunked width):
+        # admission replays prompt chunks through it interleaved with
+        # decode, so it carries the same zero-collective, grid+rngs
+        # donation contract as spec_step.
+        _entry(
+            "models.decode_engine.chunk_apply",
+            Manifest(collectives={}, donate_argnums=(1, 5)),
+        ),
         # THE headline manifests: the tp=2 serving ticks. GSPMD must
         # insert exactly the matmul-partial all-reduces (embed + wo +
         # w_down, fused per scan body) and NO all-gather above the
@@ -709,6 +717,18 @@ def default_entries() -> List[HloEntry]:
         ),
         _entry(
             "models.decode_engine.sharded_paged_step",
+            Manifest(
+                collectives={"all-reduce": 3, "all-gather": 0},
+                donate_argnums=(1, 5),
+                max_replicated_bytes=replicated_budget,
+            ),
+            requires=("multi_device",),
+        ),
+        # The tp=2 chunk-apply: chunked admission shares the decode
+        # tick's mesh, so its census is pinned identically — the three
+        # matmul-partial all-reduces and NO all-gather above the floor.
+        _entry(
+            "models.decode_engine.sharded_chunk_apply",
             Manifest(
                 collectives={"all-reduce": 3, "all-gather": 0},
                 donate_argnums=(1, 5),
@@ -767,6 +787,12 @@ def _decode_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
         )
         mask = jnp.ones((slots,), jnp.bool_)
         max_blocks = config.max_seq_len // block_size
+        width = 4  # the chunked/spec window width — a fixed compile key
+        spec_grid = engine.make_slot_cache(params, slots)
+        eos_ids = jnp.full((slots,), -1, jnp.int32)
+        spec_rngs = jnp.stack(
+            [jax.random.PRNGKey(10 + i) for i in range(slots)]
+        )
         for tick in range(3):
             # Every per-tick input varies: tokens, rngs, block tables,
             # lengths. A cache keyed on any of them recompiles here.
@@ -781,6 +807,15 @@ def _decode_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
             pool, _emitted, rngs = engine.paged_step(
                 params, pool, tables, lengths, tokens, rngs, mask,
                 block_size=block_size,
+            )
+            # The windowed tick doubles as chunked prefill's chunk-apply:
+            # n_known sweeping 0 -> width (decode-heavy to all-known
+            # replay) is traced data, never a compile key (TYA205).
+            window = jnp.full((slots, width), tick + 5, jnp.int32)
+            n_known = jnp.full((slots,), min(tick * 2, width), jnp.int32)
+            spec_grid, _emitted, _counts, spec_rngs = engine.spec_step(
+                params, spec_grid, window, n_known, eos_ids, spec_rngs,
+                mask,
             )
         return engine.program_keys()
 
@@ -827,7 +862,9 @@ def default_churn_entries() -> List[ChurnEntry]:
             _decode_churn_driver,
             # One compiled program per kind across 3 ticks of varying
             # tokens/rngs/tables/lengths — those are traced, never keys.
-            expected={"step": 1, "paged_step": 1},
+            # spec_step covers the chunk-apply: n_known sweeps the whole
+            # decode-to-replay range without minting a second program.
+            expected={"step": 1, "paged_step": 1, "spec_step": 1},
         ),
         ChurnEntry(
             "models.rank_engine.rank_churn",

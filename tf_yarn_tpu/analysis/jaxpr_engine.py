@@ -560,7 +560,12 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return fn, args, {}
 
-    def spec_step():
+    def _dense_window(width: int):
+        """The dense windowed tick at a given width: width 3 is the
+        speculative shape (spec_k=2), width 8 the chunk-apply shape
+        (prefill_chunk=8, teacher-forced prompt replay). Same program
+        builder — width is a compile-key dimension, nothing else
+        changes."""
         import jax
         import jax.numpy as jnp
 
@@ -574,7 +579,7 @@ def _decode_entries() -> List[EntryPoint]:
             build_prefill_fn(model), params,
             jax.ShapeDtypeStruct((1, 1), jnp.int32),
         )[0]
-        slots, width = 2, 3
+        slots = 2
         grid = jax.tree_util.tree_map(
             lambda leaf: jax.ShapeDtypeStruct(
                 (slots,) + leaf.shape, leaf.dtype
@@ -593,6 +598,12 @@ def _decode_entries() -> List[EntryPoint]:
             jax.ShapeDtypeStruct((slots,), jnp.bool_),        # active
         )
         return fn, args, {}
+
+    def spec_step():
+        return _dense_window(width=3)
+
+    def chunk_apply():
+        return _dense_window(width=8)
 
     def paged_spec_step():
         import jax
@@ -782,6 +793,80 @@ def _decode_entries() -> List[EntryPoint]:
     def sharded_paged_step():
         return _tp_sharded(paged=True)
 
+    def sharded_chunk_apply():
+        """The TP chunk-apply: the dense windowed program at the
+        chunked width (8), sharded exactly as DecodeEngine._spec_step
+        lowers it under a mesh — params by LOGICAL_RULES, slot grid by
+        kv-heads, window/n_known/eos/rngs/active replicated, grid +
+        rngs donated. Chunked prefill admits prompts through THIS
+        program tick by tick, so it gets the same host-callback and
+        axis-vocabulary pins as the sharded decode ticks."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from tf_yarn_tpu.models.decode_engine import (
+            build_prefill_fn,
+            build_spec_step_fn,
+            kv_partition_spec,
+        )
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_yarn_tpu.parallel import sharding as sharding_lib
+        from tf_yarn_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        tp = 2
+        config = TransformerConfig.tiny()
+        model = Transformer(config)
+        mesh = build_mesh(MeshSpec(tp=tp), jax.devices()[:tp])
+        rep = NamedSharding(mesh, PartitionSpec())
+        abstract = jax.eval_shape(
+            lambda r, t: model.init(r, t),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        )
+        param_sh = sharding_lib.tree_shardings(mesh, abstract)
+        params = sharding_lib.unbox_params(abstract)
+        max_seq = config.max_seq_len
+        slots, width = 2, 8
+        row = jax.eval_shape(
+            build_prefill_fn(model), params,
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        )[0]
+        grid = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (slots,) + leaf.shape, leaf.dtype
+            ),
+            row,
+        )
+        grid_sh = jax.tree_util.tree_map(
+            lambda aval: NamedSharding(
+                mesh, kv_partition_spec(tuple(aval.shape), max_seq, tp)
+            ),
+            grid,
+        )
+        fn = jax.jit(
+            build_spec_step_fn(
+                model, width, temperature=0.0, top_k=None, top_p=None
+            ),
+            in_shardings=(param_sh, grid_sh, rep, rep, rep, rep, rep),
+            out_shardings=(grid_sh, rep, rep, rep),
+            # Grid + rngs donated exactly as DecodeEngine._spec_step
+            # lowers it (donate=(1, 5)).
+            donate_argnums=(1, 5),
+        )
+        args = (
+            params, grid,
+            jax.ShapeDtypeStruct((slots, width), jnp.int32),  # window
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # n_known
+            jax.ShapeDtypeStruct((slots,), jnp.int32),        # eos ids
+            jax.ShapeDtypeStruct((slots, 2), jnp.uint32),     # rngs
+            jax.ShapeDtypeStruct((slots,), jnp.bool_),        # active
+        )
+        return fn, args, {}
+
     from tf_yarn_tpu.parallel.mesh import AXIS_TP
 
     return [
@@ -809,6 +894,12 @@ def _decode_entries() -> List[EntryPoint]:
         # tables), scatters the window's quantized K/V rows, and must
         # stay host-callback-free like every other tick program.
         EntryPoint("models.decode_engine.paged_spec_step", paged_spec_step),
+        # The CHUNK-APPLY: the same windowed program at the chunked
+        # width (8) — admission replays prompt chunks through it
+        # teacher-forced (n_known == W, zero emissions), interleaved
+        # with decode slots in the one tick program. A host callback
+        # here would stall every decode slot once per admitted chunk.
+        EntryPoint("models.decode_engine.chunk_apply", chunk_apply),
         # The TENSOR-PARALLEL serving ticks (tp=2): params placed by
         # LOGICAL_RULES, slot KV sharded by heads, explicit in/out
         # shardings — traced under the declared tp axis env so any
@@ -822,6 +913,15 @@ def _decode_entries() -> List[EntryPoint]:
         ),
         EntryPoint(
             "models.decode_engine.sharded_paged_step", sharded_paged_step,
+            axis_env=((AXIS_TP, 2),), expected_axes=(AXIS_TP,),
+            requires=("multi_device",),
+        ),
+        # The sharded chunk-apply twin, pinned like sharded_step so the
+        # chunked-admission program keeps the same collective census
+        # and donation aliasing under tp=2 as the decode tick it
+        # interleaves with.
+        EntryPoint(
+            "models.decode_engine.sharded_chunk_apply", sharded_chunk_apply,
             axis_env=((AXIS_TP, 2),), expected_axes=(AXIS_TP,),
             requires=("multi_device",),
         ),

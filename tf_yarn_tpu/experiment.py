@@ -342,6 +342,18 @@ class ServingExperiment:
     spec_k: int = 0
     spec_draft: Any = "ngram"
     decode_attention: str = "gather"
+    # Chunked prefill (docs/Serving.md "Chunked prefill"):
+    # ``prefill_chunk`` splits admission prefill into teacher-forced
+    # windows of that many prompt tokens riding the same compiled step
+    # decode runs, so a 2k-token prompt never stalls in-flight streams.
+    # 0 (the default) keeps the blocking admission prefill; "auto"
+    # picks the engine's largest prompt bucket (or the spec window when
+    # larger). ``prefill_budget_per_tick`` caps the prompt tokens
+    # replayed per tick across all slots (None = unlimited; the
+    # scheduler requires it >= the window width so chunking slots can
+    # always advance).
+    prefill_chunk: Any = 0
+    prefill_budget_per_tick: Optional[int] = None
     # Tensor-parallel decode (docs/Serving.md "Tensor-parallel decode"):
     # MeshSpec(tp=N) shards this replica's weights and slot KV across N
     # devices. None (default) = single-device decode, exactly as before.
@@ -404,6 +416,28 @@ class ServingExperiment:
             raise ValueError(
                 "decode_attention='fused' requires kv_layout='paged'"
             )
+        chunked = self.prefill_chunk not in (0, None)
+        if chunked and self.prefill_chunk != "auto" and (
+            not isinstance(self.prefill_chunk, int)
+            or self.prefill_chunk < 1
+        ):
+            raise ValueError(
+                "prefill_chunk must be 0/None (blocking admission "
+                "prefill), 'auto', or an int >= 1; got "
+                f"{self.prefill_chunk!r}"
+            )
+        if self.prefill_budget_per_tick is not None:
+            if not chunked:
+                raise ValueError(
+                    "prefill_budget_per_tick needs chunked prefill: set "
+                    "prefill_chunk >= 1 or 'auto' (with blocking "
+                    "admission there is no per-tick prefill to budget)"
+                )
+            if self.prefill_budget_per_tick < 1:
+                raise ValueError(
+                    "prefill_budget_per_tick must be >= 1 or None, got "
+                    f"{self.prefill_budget_per_tick}"
+                )
         if self.mesh_spec is not None:
             # Reject bad TP configs HERE — before any restore/trace —
             # with errors that name the knob, not the XLA partitioner's

@@ -321,6 +321,14 @@ def build_spec_step_fn(model, width: int, temperature: float,
     cache_index; their garbage window rows land in their own (free)
     cache and are overwritten at the next admission. tokens / n_known /
     eos_ids are traced, so tick-to-tick changes never recompile.
+
+    This program is ALSO the chunk-apply for chunked prefill
+    (docs/Serving.md "Chunked prefill"): a window whose tokens are all
+    pending prompt tokens (n_known == W) is a teacher-forced chunk —
+    the forward appends W prompt positions of KV and emits nothing.
+    The scheduler widens W to max(spec_k + 1, prefill_chunk); it is a
+    compile-key dimension, fixed per grid, so chunking adds zero
+    recompiles.
     """
     max_seq_len = model.config.max_seq_len
 
@@ -559,7 +567,10 @@ def build_paged_spec_step_fn(model, block_size: int, width: int,
     itself needs no index fixup. All `width` freshly written K/V rows
     scatter back at logical positions length..length+W-1 — rows beyond
     a slot's reserved blocks hit table entries 0 and land in the trash
-    block, so rejected drafts can never touch another slot's KV.
+    block, so rejected drafts can never touch another slot's KV. Like
+    the dense twin, this doubles as the chunk-apply for chunked prefill:
+    an all-known window (n_known == W) writes W prompt rows through the
+    block table and emits nothing.
 
     `decode_attention` picks the attention implementation inside the
     verify forward:

@@ -116,6 +116,8 @@ def plan_window(
     max_emit: int,
     context: Sequence[int],
     drafter: Optional[DrafterFn],
+    *,
+    max_drafts: Optional[int] = None,
 ) -> Tuple[List[int], int, int]:
     """One slot's window inputs for a spec step (host side).
 
@@ -123,7 +125,18 @@ def plan_window(
     (prompt-replay prefix, then up to ``max_emit - 1`` draft proposals,
     then ``-1`` fill that can never match a real token), the count of
     positions whose successor is already known, and how many drafts
-    were actually proposed (the accept-rate denominator)."""
+    were actually proposed (the accept-rate denominator).
+
+    A window whose inputs are all pending prompt tokens is a
+    **teacher-forced chunk**: ``n_known == width`` positions replay
+    known successors, nothing emits, no RNG is consumed, and the slot's
+    KV advances ``width`` tokens in one step — chunked prefill
+    (docs/Serving.md "Chunked prefill") is nothing but a stream of
+    these riding the ordinary spec step. ``max_drafts`` caps drafting
+    independently of the window width: a chunked grid widens the window
+    to ``prefill_chunk`` without widening the draft budget past
+    ``spec_k``, so the tail chunk (replay shorter than the window)
+    never over-drafts."""
     p = len(pending)
     if p > 0:
         take = min(p, width)
@@ -134,6 +147,8 @@ def plan_window(
         n_known = 0
     draft_room = width - 1 - n_known
     n_drafts = max(0, min(draft_room, max_emit - 1))
+    if max_drafts is not None:
+        n_drafts = min(n_drafts, max(0, int(max_drafts)))
     proposals: List[int] = []
     if drafter is not None and n_drafts > 0:
         proposals = [int(t) for t in drafter(context, n_drafts)][:n_drafts]

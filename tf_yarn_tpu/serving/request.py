@@ -125,12 +125,18 @@ class Response:
         self._tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self.first_token_at: Optional[float] = None
+        # monotonic arrival time of every pushed token — the raw series
+        # behind TTFT and inter-token latency (benchmarks/run.py's A/B
+        # reads it; tokens landing in one tick share a timestamp).
+        self.token_times: List[float] = []
 
     # -- producer side (the scheduler thread) ------------------------------
 
     def _push(self, token: int) -> None:
+        now = time.monotonic()
         if self.first_token_at is None:
-            self.first_token_at = time.monotonic()
+            self.first_token_at = now
+        self.token_times.append(now)
         self._tokens.append(int(token))
         self._stream.put(int(token))
 
@@ -170,6 +176,15 @@ class Response:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.request.submitted_at
+
+    def inter_token_gaps_s(self) -> List[float]:
+        """Gaps between consecutive token arrivals (empty with < 2
+        tokens) — the per-request series behind inter-token-latency
+        percentiles. Tokens accepted in one scheduler tick arrive
+        together and contribute ~0 gaps; a decode tick stalled behind a
+        blocking admission prefill shows up here as one large gap."""
+        times = self.token_times
+        return [b - a for a, b in zip(times, times[1:])]
 
 
 class AdmissionQueue:

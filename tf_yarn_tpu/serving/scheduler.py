@@ -9,7 +9,12 @@ Every scheduler tick:
    through the engine's existing bucketed prefill programs
    (`slot_prefill_len` picks the largest bucket that leaves the last
    prompt token for the step program) and queue the prompt remainder
-   for replay;
+   for replay; with **chunked prefill** (``prefill_chunk`` > 0) the
+   blocking prefill program is skipped entirely — the slot installs
+   immediately and the whole prompt queues as pending tokens that the
+   windowed step replays ``prefill_chunk`` at a time, interleaved with
+   decode under ``prefill_budget_per_tick``, so admission never stalls
+   the decode tick (docs/Serving.md "Chunked prefill");
 3. **step** ALL slots one token in ONE compiled program: replaying
    slots force their next prompt token (no RNG consumed — the split
    chain stays bit-aligned with `generate_legacy`), emitting slots feed
@@ -80,10 +85,18 @@ DECODE_ATTENTION = ("gather", "fused")
 
 
 class _Slot:
-    """Host-side state of one occupied decode slot."""
+    """Host-side state of one occupied decode slot.
+
+    A slot with non-empty ``pending`` is in its PREFILLING phase: the
+    step program is still consuming prompt tokens (the blocking path's
+    short bucket remainder, or — chunked prefill — the whole prompt).
+    It transitions to DECODING the tick its last pending token is
+    consumed, with no host-visible state change beyond the deque
+    emptying."""
 
     __slots__ = ("request", "response", "pending", "last_token", "emitted",
-                 "blocks", "context")
+                 "blocks", "context", "prompt_filled", "registered_blocks",
+                 "last_emit_at")
 
     def __init__(self, request: Request, response: Response,
                  pending: List[int], blocks: Optional[List[int]] = None):
@@ -101,6 +114,15 @@ class _Slot:
         # speculative drafter's lookup corpus. Appended to only on the
         # windowed path.
         self.context: List[int] = list(request.prompt)
+        # Prompt tokens with valid KV (prefilled/hit + replayed so far);
+        # drives the chunked path's incremental prefix registration.
+        self.prompt_filled = len(request.prompt) - len(self.pending)
+        # Whole prompt blocks already offered to the prefix cache
+        # (chunked paged path only).
+        self.registered_blocks = 0
+        # monotonic time of the last token push — the inter-token
+        # latency histogram's reference point.
+        self.last_emit_at: Optional[float] = None
 
 
 class SlotScheduler:
@@ -127,6 +149,21 @@ class SlotScheduler:
     forward). Emitted streams are identical to the exact path; each
     tick just advances 1..spec_k+1 tokens per slot, and
     ``context_limit`` shrinks by ``spec_k`` (window scratch headroom).
+
+    Chunked prefill (docs/Serving.md "Chunked prefill"):
+    ``prefill_chunk`` > 0 replaces the blocking admission prefill with
+    teacher-forced windows of that many prompt tokens riding the SAME
+    windowed step program decode runs — admit installs the slot
+    immediately and every tick mixes chunking and decoding slots in one
+    compiled program ("auto" = the engine's largest prompt bucket, or
+    the spec window when larger; 0/None = the blocking path).
+    ``prefill_budget_per_tick`` caps the prompt tokens replayed per
+    tick across all slots — over-budget slots pause (masked off,
+    consuming nothing) in round-robin order, so a burst of long
+    prompts cannot monopolize the window while decode slots ride the
+    same program untouched. Emitted streams stay BIT-IDENTICAL to the
+    blocking path (replay consumes no RNG either way), and
+    ``context_limit`` reserves ``window - 1`` positions of KV headroom.
     """
 
     def __init__(
@@ -149,6 +186,8 @@ class SlotScheduler:
         spec_k: int = 0,
         spec_draft="ngram",
         decode_attention: str = "gather",
+        prefill_chunk=None,
+        prefill_budget_per_tick: Optional[int] = None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -194,10 +233,50 @@ class SlotScheduler:
         # tick also carries the fused-attention path at width 1, so
         # decode_attention="fused" alone routes through it.
         self._spec_width = self.spec_k + 1
-        self._windowed = self.spec_k > 0 or decode_attention == "fused"
+        # Chunked prefill (docs/Serving.md "Chunked prefill"): resolve
+        # the chunk width, widen the window to cover it, and route the
+        # tick through the windowed program.
+        if prefill_chunk in (None, 0):
+            chunk = 0
+        elif prefill_chunk == "auto":
+            buckets = getattr(engine, "prompt_buckets", None) or ()
+            chunk = max([self._spec_width] + [int(b) for b in buckets])
+        else:
+            chunk = int(prefill_chunk)
+            if chunk < 1:
+                raise ValueError(
+                    "prefill_chunk must be >= 1, 'auto', or 0/None "
+                    f"(blocking admission), got {prefill_chunk!r}"
+                )
+        self.prefill_chunk = chunk
+        self._chunked = chunk > 0
+        self._window_width = max(self._spec_width, chunk) \
+            if self._chunked else self._spec_width
+        self._windowed = (
+            self.spec_k > 0 or decode_attention == "fused" or self._chunked
+        )
+        if prefill_budget_per_tick is not None:
+            if not self._chunked:
+                raise ValueError(
+                    "prefill_budget_per_tick needs chunked prefill "
+                    "(prefill_chunk >= 1 or 'auto'); with blocking "
+                    "admission there is no per-tick prefill to budget"
+                )
+            budget = int(prefill_budget_per_tick)
+            if budget < self._window_width:
+                raise ValueError(
+                    f"prefill_budget_per_tick ({budget}) must be >= the "
+                    f"window width ({self._window_width}, i.e. "
+                    "max(prefill_chunk, spec_k + 1)) or no chunking slot "
+                    "could ever advance"
+                )
+            prefill_budget_per_tick = budget
+        self.prefill_budget_per_tick = prefill_budget_per_tick
         self._drafter = make_drafter(spec_draft) if self.spec_k > 0 else None
         self._spec_proposed = 0
         self._spec_accepted = 0
+        self._prefill_tokens = 0
+        self._decode_tokens = 0
         self.queue = AdmissionQueue(queue_capacity, retry_after_s)
         self._rngs = np.zeros((max_slots, 2), np.uint32)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
@@ -277,13 +356,16 @@ class SlotScheduler:
     @property
     def context_limit(self) -> Optional[int]:
         """Max prompt + max_new_tokens this grid can serve, or None when
-        unknown (fake engines without a config). Speculative decoding
-        reserves `spec_k` positions of KV headroom per slot: a window
-        writes all spec_k + 1 rows before acceptance is known, so the
-        last tick's rejected rows must still land inside the cache."""
+        unknown (fake engines without a config). The windowed paths
+        reserve ``window - 1`` positions of KV headroom per slot: a
+        window writes all its rows before acceptance is known, so the
+        last tick's rejected (or paused-garbage) rows must still land
+        inside the cache. window = max(spec_k + 1, prefill_chunk), so
+        the exact path loses nothing and the spec path loses spec_k
+        exactly as before."""
         if self._max_seq_len is None:
             return None
-        return self._max_seq_len - self.spec_k
+        return self._max_seq_len - (self._window_width - 1)
 
     def submit(
         self,
@@ -317,8 +399,9 @@ class SlotScheduler:
             len(request.prompt) + params.max_new_tokens > limit
         ):
             headroom = (
-                f" minus the spec_k={self.spec_k} window headroom"
-                if self.spec_k else ""
+                f" minus the {self._window_width - 1}-token window "
+                "headroom (max(spec_k, prefill_chunk - 1))"
+                if self._window_width > 1 else ""
             )
             raise ValueError(
                 f"prompt ({len(request.prompt)}) + max_new_tokens "
@@ -465,6 +548,15 @@ class SlotScheduler:
     def _admit_dense(self, request: Request, response: Response,
                      now: float, admitted: List[int]) -> None:
         slot = self._free.popleft()
+        if self._chunked:
+            # Chunked prefill: no blocking prefill program at all. The
+            # slot starts from a zeroed cache_index and the WHOLE prompt
+            # queues as pending replay — the windowed tick consumes it
+            # prefill_chunk tokens at a time, interleaved with decode.
+            self._cache = self.engine.evict_slot(self._cache, slot)
+            self._slots[slot] = _Slot(request, response, list(request.prompt))
+            self._record_admission(slot, request, now, admitted)
+            return
         prefill_len = self.engine.slot_prefill_len(len(request.prompt))
         with telemetry.span(
             "serving/prefill", request=request.id, prefill=prefill_len
@@ -516,6 +608,13 @@ class SlotScheduler:
         if hit_tokens:
             prefill_len = hit_tokens
             self._registry.counter("serving/prefix_cache_hits_total").inc()
+        elif self._chunked:
+            # Chunked prefill: blocks are reserved exactly as above, but
+            # nothing prefills at admission — the whole prompt queues as
+            # pending replay and the windowed tick appends K/V rows to
+            # this slot's blocks chunk by chunk, registering each
+            # completed whole block with the prefix cache as it fills.
+            prefill_len = 0
         else:
             prefill_len = self.engine.slot_prefill_len(len(prompt))
             with telemetry.span(
@@ -539,9 +638,14 @@ class SlotScheduler:
         self._tables[slot, :] = 0
         self._tables[slot, :len(blocks)] = blocks
         self._lengths[slot] = prefill_len
-        self._slots[slot] = _Slot(
+        state = _Slot(
             request, response, list(prompt[prefill_len:]), blocks=blocks
         )
+        # Whole blocks already covered (prefix hit or blocking prefill's
+        # registration above): the chunked incremental registration
+        # starts past them.
+        state.registered_blocks = prefill_len // self._block_size
+        self._slots[slot] = state
         self._record_admission(slot, request, now, admitted)
         return True
 
@@ -575,6 +679,9 @@ class SlotScheduler:
         # np.array (copy): admissions write PRNGKey rows into this
         # buffer, and np.asarray of a device array is read-only.
         self._rngs = np.array(rngs)
+        now = time.monotonic()
+        prefill_tokens = 0
+        decode_tokens = 0
         for slot in active:
             state = self._slots[slot]
             if self.kv_layout == "paged":
@@ -585,52 +692,86 @@ class SlotScheduler:
             sampled = bool(mask[slot])
             if state.pending:
                 state.pending.popleft()
+                state.prompt_filled += 1
+                prefill_tokens += 1
             if not sampled:
                 continue
             token = int(emitted[slot])
             state.last_token = token
             state.emitted += 1
+            decode_tokens += 1
             first = state.response.first_token_at is None
             state.response._push(token)
             if first:
                 self._registry.histogram("serving/ttft_seconds").observe(
                     state.response.ttft_s
                 )
+            elif state.last_emit_at is not None:
+                self._registry.histogram(
+                    "serving/inter_token_latency_ms"
+                ).observe((now - state.last_emit_at) * 1e3)
+            state.last_emit_at = now
             self._registry.counter("serving/tokens_generated_total").inc()
             eos = state.request.params.eos_token
             if eos is not None and token == eos:
                 self._retire(slot, FINISH_EOS, retired)
             elif state.emitted >= state.request.params.max_new_tokens:
                 self._retire(slot, FINISH_LENGTH, retired)
+        self._account_tokens(prefill_tokens, decode_tokens)
 
     def _step_spec(self, active: List[int], retired: List) -> Dict[int, int]:
-        """The speculative tick: ONE compiled windowed program advances
-        every slot a VARIABLE number of tokens (1 up to spec_k + 1).
-        Drafts come from the host-side drafter over each slot's own
-        token history; replay prefixes ride in the same window, so a
-        long prompt remainder also advances up to the full window per
-        tick. Returns {request id: tokens emitted} for the trace ring.
+        """The windowed tick: ONE compiled program advances every slot a
+        VARIABLE number of tokens — decode slots 1 up to spec_k + 1
+        (drafts from the host-side drafter over the slot's own token
+        history), PREFILLING slots up to the full window of teacher-
+        forced prompt replay (chunked prefill rides here: a chunking
+        slot is just a slot whose pending deque still holds its prompt).
+        ``prefill_budget_per_tick`` caps the prompt tokens consumed per
+        tick: chunking slots past the budget are masked off for the tick
+        (they consume nothing, emit nothing, and their cache index/
+        length stay put — the window's garbage rows land beyond the
+        valid length and are overwritten on resume), with round-robin
+        rotation so every chunking slot advances within a bounded number
+        of ticks. Decode slots are NEVER paused — that is the no-stall
+        contract. Returns {request id: tokens emitted} for the trace
+        ring.
         """
-        width = self._spec_width
+        width = self._window_width
         tokens = np.full((self.max_slots, width), -1, np.int32)
         n_known = np.zeros((self.max_slots,), np.int32)
         eos_ids = np.full((self.max_slots,), -1, np.int32)
         mask = np.zeros((self.max_slots,), bool)
         consumed: Dict[int, int] = {}
         proposed: Dict[int, int] = {}
-        for slot in active:
+        budget = self.prefill_budget_per_tick
+        order = active
+        if budget is not None and len(active) > 1:
+            # Rotate who claims prefill budget first each tick so a
+            # burst of long prompts shares it fairly.
+            pivot = self._ticks % len(active)
+            order = active[pivot:] + active[:pivot]
+        for slot in order:
             state = self._slots[slot]
+            need = min(len(state.pending), width)
+            if budget is not None and need > 0:
+                if need > budget:
+                    # Paused this tick (over budget): stays masked off —
+                    # the free-slot convention.
+                    consumed[slot] = 0
+                    proposed[slot] = 0
+                    continue
+                budget -= need
             max_emit = state.request.params.max_new_tokens - state.emitted
             window, known, n_prop = plan_window(
                 state.pending, state.last_token, width, max_emit,
-                state.context, self._drafter,
+                state.context, self._drafter, max_drafts=self.spec_k,
             )
             tokens[slot] = window
             n_known[slot] = known
             eos = state.request.params.eos_token
             eos_ids[slot] = -1 if eos is None else eos
             mask[slot] = True
-            consumed[slot] = min(len(state.pending), width)
+            consumed[slot] = need
             proposed[slot] = n_prop
         if self.kv_layout == "paged":
             self._pool, emitted, counts, rngs = self.engine.paged_spec_step(
@@ -652,16 +793,24 @@ class SlotScheduler:
         emitted = np.asarray(emitted)
         counts = np.asarray(counts)
         self._rngs = np.array(rngs)
+        now = time.monotonic()
+        prefill_tokens = 0
+        decode_tokens = 0
         accepts: Dict[int, int] = {}
         for slot in active:
             state = self._slots[slot]
             for _ in range(consumed[slot]):
                 state.pending.popleft()
+            state.prompt_filled += consumed[slot]
+            prefill_tokens += consumed[slot]
             n = int(counts[slot])
+            decode_tokens += n
             if self.kv_layout == "paged":
                 # Valid rows this tick: the replayed prefix + the
                 # emitted tokens; rejected window rows beyond stay dead.
                 self._lengths[slot] += int(n_known[slot]) + n
+                if self._chunked and consumed[slot]:
+                    self._register_chunk_prefix(state)
             if proposed[slot]:
                 accepted_drafts = min(max(n - 1, 0), proposed[slot])
                 self._spec_proposed += proposed[slot]
@@ -689,6 +838,13 @@ class SlotScheduler:
                     self._registry.histogram(
                         "serving/ttft_seconds"
                     ).observe(state.response.ttft_s)
+                elif state.last_emit_at is not None:
+                    # Tokens landing in the same tick (accepted drafts)
+                    # record a ~0 gap — they really do arrive together.
+                    self._registry.histogram(
+                        "serving/inter_token_latency_ms"
+                    ).observe((now - state.last_emit_at) * 1e3)
+                state.last_emit_at = now
                 self._registry.counter(
                     "serving/tokens_generated_total"
                 ).inc()
@@ -703,7 +859,36 @@ class SlotScheduler:
             self._registry.gauge("serving/spec_accept_rate").set(
                 self._spec_accepted / self._spec_proposed
             )
+        self._account_tokens(prefill_tokens, decode_tokens)
         return accepts
+
+    def _register_chunk_prefix(self, state: _Slot) -> None:
+        """Offer every prompt block a chunk just completed to the prefix
+        cache (chunked paged path). `PrefixCache.register` is idempotent
+        per prefix key and takes its OWN reference on newly shared
+        blocks, so the slot's one reference (released at retire) is
+        never double-counted — a mid-PREFILL eviction releases exactly
+        the slot's refs and cached blocks survive for the next hit."""
+        whole = state.prompt_filled // self._block_size
+        if whole > state.registered_blocks:
+            self._prefix.register(
+                state.request.prompt, state.prompt_filled, state.blocks
+            )
+            state.registered_blocks = whole
+
+    def _account_tokens(self, prefill_tokens: int, decode_tokens: int) -> None:
+        """Per-tick token throughput split: prompt tokens consumed
+        (prefill/replay) vs tokens emitted (decode)."""
+        self._prefill_tokens += prefill_tokens
+        self._decode_tokens += decode_tokens
+        if prefill_tokens:
+            self._registry.counter("serving/prefill_tokens_total").inc(
+                prefill_tokens
+            )
+        if decode_tokens:
+            self._registry.counter("serving/decode_tokens_total").inc(
+                decode_tokens
+            )
 
     def _retire(self, slot: int, reason: str, retired: List) -> None:
         state = self._slots[slot]
@@ -815,6 +1000,10 @@ class SlotScheduler:
             "draining": self._draining,
             "spec_k": self.spec_k,
             "decode_attention": self.decode_attention,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_budget_per_tick": self.prefill_budget_per_tick,
+            "prefill_tokens": self._prefill_tokens,
+            "decode_tokens": self._decode_tokens,
         }
         if self._windowed:
             snap["spec"] = {

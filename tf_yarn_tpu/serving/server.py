@@ -313,6 +313,8 @@ def run_serving(experiment, runtime=None) -> dict:
         spec_k=experiment.spec_k,
         spec_draft=experiment.spec_draft,
         decode_attention=experiment.decode_attention,
+        prefill_chunk=experiment.prefill_chunk,
+        prefill_budget_per_tick=experiment.prefill_budget_per_tick,
     )
     server = ServingServer(scheduler, experiment.host, experiment.port)
     scheduler.start()

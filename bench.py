@@ -897,6 +897,32 @@ def _record_cpu_serve_ab(result: dict) -> None:
     _log(f"cpu serve layout A/B: {serve.get('layouts')}")
 
 
+def _record_analysis_seconds(result: dict) -> None:
+    """Per-engine wall seconds for the four-engine static checker
+    (ast/jaxpr/hlo/concurrency over tf_yarn_tpu/), folded into the
+    headline line as `analysis_*_s` tracked fields. The checker is a
+    tier-1 gate, so its budget drifting up is a regression this line
+    makes visible round over round. Device-independent (tiny traced
+    shapes, pure-Python lockset scenarios), so it runs on every rig.
+    TPU_YARN_BENCH_SKIP_ANALYSIS=1 opts out for a quick run."""
+    if os.environ.get("TPU_YARN_BENCH_SKIP_ANALYSIS") == "1":
+        return
+    try:
+        suite = _load_bench_suite()
+        stats = suite.bench_analysis(tpu=False)
+    except Exception as exc:  # the bench headline must still print
+        _log(f"analysis bench FAILED: {type(exc).__name__}: {exc}")
+        return
+    for key in ("total_s", "ast_s", "jaxpr_s", "hlo_s", "concurrency_s"):
+        if key in stats:
+            result[f"analysis_{key}"] = round(float(stats[key]), 4)
+    if "exit_code" in stats:
+        result["analysis_exit_code"] = stats["exit_code"]
+    if "error" in stats:
+        result["analysis_error"] = stats["error"]
+    _log(f"analysis engine seconds: {stats}")
+
+
 def _run_family_blitz(suite, ab) -> None:
     """The model-family A/B matrices (bert fused-LN fwd/bwd, resnet
     stem/batch, ViT fused-LN): a wedged relay has starved every round of
@@ -935,6 +961,7 @@ def main() -> None:
         except (ValueError, OSError):
             pass
     result["vs_baseline"] = vs_baseline
+    _record_analysis_seconds(result)
     print(json.dumps(result))
     sys.stdout.flush()
     # Post-headline capture: the family matrices only ever ADD to

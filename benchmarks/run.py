@@ -1517,6 +1517,57 @@ def bench_ici_allreduce(tpu: bool):
     )
 
 
+def bench_analysis(tpu: bool):
+    """Wall seconds per static-analysis engine (ast/jaxpr/hlo/concurrency)
+    over the repo's own tree — the checker is a tier-1 gate, so its
+    budget is a tracked number, not a vibe. Runs the real CLI in a
+    subprocess (the exact gate invocation, import cost included) and
+    reports the per-engine breakdown the CLI already times.
+
+    Device-independent: the jaxpr/hlo engines trace tiny shapes and the
+    lockset scenarios are pure-Python, so the CPU number IS the claim.
+    """
+    import subprocess
+    import time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")  # the gate's environment
+    started = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_yarn_tpu.analysis", "tf_yarn_tpu",
+         "--json"],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    total_s = time.monotonic() - started
+    if proc.returncode != 0:
+        # A dirty tree is a finding, not a crash: surface it in-band so
+        # the bench line records WHY the seconds are missing.
+        return {
+            "exit_code": proc.returncode,
+            "total_s": total_s,
+            "error": (proc.stdout or proc.stderr).strip()[:400],
+        }
+    payload = json.loads(proc.stdout)
+    race = payload.get("race_report") or {}
+    return {
+        "exit_code": proc.returncode,
+        "total_s": total_s,
+        **{f"{name}_s": secs
+           for name, secs in (payload.get("engine_seconds") or {}).items()},
+        "n_findings": payload.get("n_findings"),
+        "n_suppressed": len(payload.get("suppressed_findings") or ()),
+        "race_scenarios": len(race),
+        "race_accesses": sum(
+            s.get("accesses", 0) for s in race.values()
+        ),
+        "note": (
+            "per-engine wall seconds for the four-engine checker on "
+            "tf_yarn_tpu/ (subprocess = gate-identical, interpreter "
+            "startup inside total_s only)"
+        ),
+    }
+
+
 CONFIGS = {
     "mnist_dense": bench_mnist_dense,
     "linear_clicks": bench_linear_clicks,
@@ -1531,6 +1582,7 @@ CONFIGS = {
     "fleet": bench_fleet,
     "rank": bench_rank,
     "ici_allreduce": bench_ici_allreduce,
+    "analysis": bench_analysis,
 }
 
 

@@ -1,0 +1,20 @@
+"""Fixture: MUST flag exactly TYA301 (unguarded-shared-write).
+
+`total` is written under `self._lock` in add() but bare in reset() —
+one code path skips the discipline the others established.
+"""
+
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def reset(self):
+        self.total = 0

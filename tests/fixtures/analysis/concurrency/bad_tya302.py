@@ -1,0 +1,25 @@
+"""Fixture: MUST flag exactly TYA302 (check-then-act-without-guard).
+
+The PR 9 orbax bug shape: `stop()` tests `self._thread` and then uses
+it with no lock held across the pair — a concurrent stop() can null
+the attribute between the test and the join.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._thread = None
+
+    def _run(self):
+        pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

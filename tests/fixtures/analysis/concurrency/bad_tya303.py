@@ -1,0 +1,22 @@
+"""Fixture: MUST flag exactly TYA303 (thread-without-join).
+
+The pump thread is started but no stop()/close()/shutdown()-reachable
+path ever joins it — teardown can't prove the worker exited.
+"""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._stop.wait()
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()

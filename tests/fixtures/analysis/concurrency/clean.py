@@ -1,0 +1,41 @@
+"""Fixture: MUST produce zero TYA3xx findings.
+
+Exercises every discipline the engine recognizes: consistent guarding,
+a `# guarded-by:` annotation, the `*_locked` naming convention, the
+raise-only idempotence check, and the snapshot-under-lock stop with the
+join (via a tuple-swap local alias) outside the lock.
+"""
+
+import threading
+from typing import Optional
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lifecycle = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self):
+        pass
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def _reset_locked(self):
+        self.total = 0
+
+    def start(self):
+        with self._lifecycle:
+            if self._thread is not None:
+                raise RuntimeError("already started")
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)

@@ -1,0 +1,30 @@
+"""Race fixture: the properly-guarded twin of racy.py — the lockset
+checker MUST stay silent (every access shares `_lock`, so the lockset
+intersection never empties)."""
+
+import threading
+
+from tf_yarn_tpu.analysis.racecheck import Scenario
+
+
+class GuardedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+
+def _run(tracer):
+    counter = GuardedCounter()
+    tracer.watch(counter, "counter")
+    for name in ("race-t1", "race-t2", "race-t3"):
+        thread = threading.Thread(target=counter.bump, name=name)
+        thread.start()
+        thread.join(timeout=10.0)
+
+
+def build_scenario() -> Scenario:
+    return Scenario(name="fixture.guarded", run=_run)

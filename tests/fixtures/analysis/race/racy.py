@@ -1,0 +1,35 @@
+"""Race fixture: a seeded two-thread unguarded counter the lockset
+checker MUST flag (TYA311).
+
+Three sequential single-access threads are the minimum detectable
+shape: the first write establishes exclusive ownership, the second
+thread's access consumes the one init-then-handoff ownership transfer
+the Eraser heuristic grants, and the third thread's write proves the
+variable is genuinely shared with an empty lockset.
+"""
+
+import threading
+
+from tf_yarn_tpu.analysis.racecheck import Scenario
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self.value += 1  # read-modify-write, lock never taken
+
+
+def _run(tracer):
+    counter = RacyCounter()
+    tracer.watch(counter, "counter")
+    for name in ("race-t1", "race-t2", "race-t3"):
+        thread = threading.Thread(target=counter.bump, name=name)
+        thread.start()
+        thread.join(timeout=10.0)
+
+
+def build_scenario() -> Scenario:
+    return Scenario(name="fixture.racy", run=_run)

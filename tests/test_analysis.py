@@ -22,9 +22,18 @@ from tf_yarn_tpu.analysis.rules import RULES
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
 HLO_FIXTURES = os.path.join(FIXTURES, "hlo")
+CONC_FIXTURES = os.path.join(FIXTURES, "concurrency")
+RACE_FIXTURES = os.path.join(FIXTURES, "race")
 
 AST_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "ast")
 HLO_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "hlo")
+# The static half of the concurrency engine (TYA311/312 are dynamic-only
+# and exercised through the racecheck scenario tests below).
+CONC_STATIC_RULES = ["TYA301", "TYA302", "TYA303"]
+SCENARIO_NAMES = {
+    "serving.slot_scheduler", "ranking.micro_batch", "fleet.registry",
+    "telemetry.metrics_spans", "checkpoint.writer",
+}
 
 
 # --- AST engine: each rule fires on its fixture, and only its rule -------
@@ -100,25 +109,38 @@ def _run_checker(*args):
 
 
 def test_repo_passes_its_own_checker():
-    """THE analysis gate: one invocation runs AST + jaxpr + HLO over the
-    repo, and the per-engine wall time lands in the tier-1 log so a
-    creeping analysis budget is visible, not just felt."""
+    """THE analysis gate: one invocation runs AST + jaxpr + HLO +
+    concurrency over the repo, and the per-engine wall time lands in
+    the tier-1 log so a creeping analysis budget is visible, not just
+    felt."""
     import json
 
     proc = _run_checker("tf_yarn_tpu", "--json")
     assert proc.returncode == 0, (
         "the checker found problems in tf_yarn_tpu/ — fix them, "
-        "suppress with # noqa: TYA0xx / entry allow=, or re-baseline "
-        f"hlo_budgets.json:\n{proc.stdout}\n{proc.stderr}"
+        "suppress with # noqa: TYA0xx / entry allow= / scenario allow=, "
+        f"or re-baseline hlo_budgets.json:\n{proc.stdout}\n{proc.stderr}"
     )
     payload = json.loads(proc.stdout)
-    assert payload["json_schema_version"] == 2
+    assert payload["json_schema_version"] == 3
     seconds = payload["engine_seconds"]
-    assert set(seconds) == {"ast", "jaxpr", "hlo"}
+    assert set(seconds) == {"ast", "jaxpr", "hlo", "concurrency"}
     print(
         "analysis engine seconds: "
         + " ".join(f"{k}={v}" for k, v in sorted(seconds.items()))
     )
+    # All five lockset scenarios ran over the real hot objects, with
+    # zero unsuppressed races (suppressions are justified in
+    # docs/StaticAnalysis.md and surface in suppressed_findings).
+    race_report = payload["race_report"]
+    assert set(race_report) == SCENARIO_NAMES
+    for name, scenario in race_report.items():
+        assert scenario["races"] == scenario["suppressed"], (name, scenario)
+        assert scenario["lock_cycles"] == [], (name, scenario)
+        assert scenario["threads"] >= 2, (name, scenario)
+    assert any(
+        f["code"] == "TYA311" for f in payload["suppressed_findings"]
+    ), "expected the advisory-counter suppressions to surface"
     # The headline manifest ran (8 CPU devices are forced in this env):
     # sharded_step's census is present, with its exact all-reduce count
     # and zero above-floor all-gathers baked into the manifest check.
@@ -169,22 +191,28 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
 
 
 def test_fixtures_fail_the_checker():
-    proc = _run_checker(FIXTURES, "--no-jaxpr", "--no-hlo")
+    # --no-race: the fixture sweep wants the static lints only (the
+    # dynamic scenario suite audits the repo, not fixture files).
+    proc = _run_checker(FIXTURES, "--no-jaxpr", "--no-hlo", "--no-race")
     assert proc.returncode == 2, proc.stdout + proc.stderr
-    # every AST rule shows up in the aggregate run
-    for code in AST_RULES:
+    # every AST + static-concurrency rule shows up in the aggregate run
+    for code in AST_RULES + CONC_STATIC_RULES:
         assert code in proc.stdout, f"{code} missing from:\n{proc.stdout}"
 
 
 def test_checker_json_output():
     import json
 
-    proc = _run_checker(FIXTURES, "--no-jaxpr", "--no-hlo", "--json")
+    proc = _run_checker(
+        FIXTURES, "--no-jaxpr", "--no-hlo", "--no-race", "--json"
+    )
     assert proc.returncode == 2
     payload = json.loads(proc.stdout)
-    assert payload["json_schema_version"] == 2
+    assert payload["json_schema_version"] == 3
     assert payload["n_findings"] == len(payload["findings"]) > 0
-    assert {f["code"] for f in payload["findings"]} >= set(AST_RULES)
+    assert {f["code"] for f in payload["findings"]} >= set(
+        AST_RULES + CONC_STATIC_RULES
+    )
     # suppressed findings surface as notices, never silently vanish
     assert "suppressed_findings" in payload
 
@@ -503,3 +531,305 @@ def test_hlo_budget_file_is_checked_in_and_current_schema():
     )
     assert entries["models.decode_engine.sharded_paged_step"][
         "collectives"]["all-reduce"]["count"] == 3
+
+
+# --- concurrency engine: static lint (TYA301-303) ------------------------
+
+@pytest.mark.parametrize("code", CONC_STATIC_RULES)
+def test_concurrency_bad_fixture_flags_exactly_its_rule(code):
+    from tf_yarn_tpu.analysis.concurrency import (
+        analyze_paths as analyze_concurrency,
+    )
+
+    path = os.path.join(CONC_FIXTURES, f"bad_{code.lower()}.py")
+    findings = analyze_concurrency([path])
+    codes = {f.code for f in findings}
+    assert codes == {code}, (
+        f"{path} expected only {code}, got {sorted(codes)}: "
+        f"{[f.format() for f in findings]}"
+    )
+
+
+def test_concurrency_clean_fixture_has_no_findings():
+    from tf_yarn_tpu.analysis.concurrency import (
+        analyze_paths as analyze_concurrency,
+    )
+
+    findings = analyze_concurrency(
+        [os.path.join(CONC_FIXTURES, "clean.py")]
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_concurrency_repo_lint_is_clean():
+    """The in-process half of the gate (the subprocess repo gate above
+    covers the CLI): today's tree satisfies its own lock discipline.
+    This is also the regression net for the PR 16 fixes — reverting the
+    ServingServer/RankServer/RouterServer/SlotScheduler/
+    MicroBatchScheduler/Heartbeat stop paths, the KVServer join, or the
+    RankEngine stats guard re-flags here."""
+    from tf_yarn_tpu.analysis.concurrency import (
+        analyze_paths as analyze_concurrency,
+    )
+
+    findings = analyze_concurrency([os.path.join(REPO, "tf_yarn_tpu")])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_concurrency_noqa_suppresses(tmp_path):
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.total = 0\n"
+        "    def add(self, n):\n"
+        "        with self._lock:\n"
+        "            self.total += n\n"
+        "    def reset(self):\n"
+        "        self.total = 0  # noqa: TYA301\n"
+    )
+    path = tmp_path / "noqa_conc.py"
+    path.write_text(src)
+    from tf_yarn_tpu.analysis.concurrency import (
+        analyze_paths as analyze_concurrency,
+    )
+
+    assert analyze_concurrency([str(path)]) == []
+
+
+def test_guarded_by_annotation_binds_the_guard(tmp_path):
+    """A `# guarded-by: <lock>` annotation makes EVERY unguarded write a
+    finding — even when the with-block inference alone would see only
+    one guarded site."""
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.total = 0  # guarded-by: _lock\n"
+        "    def reset(self):\n"
+        "        self.total = 0\n"
+    )
+    path = tmp_path / "guarded_by.py"
+    path.write_text(src)
+    from tf_yarn_tpu.analysis.concurrency import (
+        analyze_paths as analyze_concurrency,
+    )
+
+    findings = analyze_concurrency([str(path)])
+    assert [f.code for f in findings] == ["TYA301"]
+
+
+# --- concurrency engine: dynamic lockset checker (TYA311/312) ------------
+
+
+def _load_race_fixture(name):
+    path = os.path.join(RACE_FIXTURES, f"{name}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"race_fixture_{name}", path
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module.build_scenario()
+
+
+def test_seeded_race_fixture_is_flagged():
+    from tf_yarn_tpu.analysis.racecheck import run_scenario
+
+    report = run_scenario(_load_race_fixture("racy"))
+    assert [f.code for f in report.findings] == ["TYA311"]
+    message = report.findings[0].message
+    # both call sites ride along in the finding
+    assert "counter.value" in message
+    assert "race-t" in message
+    assert report.n_threads == 3
+
+
+def test_guarded_race_fixture_is_clean():
+    from tf_yarn_tpu.analysis.racecheck import run_scenario
+
+    report = run_scenario(_load_race_fixture("guarded"))
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.races == []
+    assert report.n_threads == 3
+    assert report.n_accesses > 0  # the tracer did observe the accesses
+
+
+def test_lock_order_cycle_is_flagged():
+    import threading
+
+    from tf_yarn_tpu.analysis.racecheck import (
+        RaceTracer, Scenario, run_scenario,
+    )
+
+    class TwoLocks:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+    def drive(tracer):
+        obj = TwoLocks()
+        tracer.watch(obj, "locks")
+        with obj.a:
+            with obj.b:
+                pass
+        with obj.b:
+            with obj.a:
+                pass
+
+    report = run_scenario(Scenario(name="cycle", run=drive))
+    assert [f.code for f in report.findings] == ["TYA312"]
+    assert report.cycles, "the a->b->a cycle must be in the report"
+    assert "locks.a" in report.findings[0].message
+    assert "locks.b" in report.findings[0].message
+
+
+def test_scenario_suite_zero_unsuppressed_races():
+    """The tier-1 lockset gate over the REAL hot objects: a new
+    unguarded access to scheduler state, BlockPool/PrefixCache
+    refcounts, registry replicas, checkpoint futures, or telemetry
+    instruments fails here with both stack traces in the message."""
+    from tf_yarn_tpu.analysis import racecheck
+
+    report = racecheck.run()
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert set(report.report) == SCENARIO_NAMES
+    for name, scenario in report.report.items():
+        assert scenario["lock_cycles"] == [], (name, scenario)
+        assert scenario["threads"] >= 2, (name, scenario)
+        assert scenario["accesses"] > 0, (name, scenario)
+    # every suppression is a justified TYA311 advisory-counter entry
+    assert all(f.code == "TYA311" for f in report.suppressed)
+    assert all("allowed:" in f.message for f in report.suppressed)
+
+
+def test_race_tracer_preserves_scheduler_behavior():
+    """Overhead guard: instrumentation must never heisenbug the
+    scheduler — the traced run emits the same tokens and the same tick
+    trace (modulo global request ids) as the plain run."""
+    from tf_yarn_tpu.analysis.racecheck import RaceTracer
+    from tf_yarn_tpu.analysis.scenarios import (
+        drive_paged_scheduler, make_paged_scheduler,
+    )
+
+    prompts = [[1, 2, 3, 4, 5], [2, 3, 4, 5, 6], [7, 8, 9, 10, 11]]
+
+    def shape(scheduler):
+        return [
+            (
+                entry["tick"], len(entry["admitted"]),
+                sorted(reason for _, reason in entry["retired"]),
+                entry["active"], entry["queued"],
+            )
+            for entry in scheduler.trace
+        ]
+
+    plain = make_paged_scheduler()
+    plain_tokens = [
+        r.result(5.0) for r in drive_paged_scheduler(plain, prompts)
+    ]
+
+    traced = make_paged_scheduler()
+    tracer = RaceTracer()
+    tracer.watch(traced, "scheduler")
+    tracer.watch(traced._blocks, "pool")
+    tracer.watch(traced._prefix, "prefix")
+    try:
+        traced_tokens = [
+            r.result(5.0) for r in drive_paged_scheduler(traced, prompts)
+        ]
+    finally:
+        tracer.release()
+
+    assert traced_tokens == plain_tokens
+    assert shape(traced) == shape(plain)
+    assert tracer.n_accesses > 0
+    # and release() restored the real class: no proxy left behind
+    assert type(traced).__module__ != "tf_yarn_tpu.analysis.racecheck"
+
+
+@pytest.mark.slow
+def test_scenario_suite_is_deterministic_across_repeats():
+    """Heavyweight stability pass (slow rig precedent: PR 12/14): the
+    sequential-phase drivers must produce the identical race set every
+    run — zero flake by construction."""
+    from tf_yarn_tpu.analysis import racecheck
+
+    baseline = None
+    for _ in range(3):
+        report = racecheck.run()
+        assert report.findings == []
+        counts = {
+            name: (entry["races"], entry["suppressed"])
+            for name, entry in report.report.items()
+        }
+        if baseline is None:
+            baseline = counts
+        assert counts == baseline
+
+
+@pytest.mark.slow
+def test_registry_scenario_scales_to_a_large_fleet():
+    """Heavyweight registry variant: 8 replicas, repeated refresh/fail/
+    policy cycles — the fast in-suite representative is the 2-replica
+    scenario inside default_scenarios()."""
+    import threading
+
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.analysis.racecheck import RaceTracer
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet.policy import LeastLoadedPolicy
+    from tf_yarn_tpu.fleet.registry import ReplicaRegistry
+
+    kv = InProcessKV()
+    tasks = [f"serving:{i}" for i in range(8)]
+    for index, task in enumerate(tasks):
+        kv.put_str(
+            f"{task}/{event.SERVING_ENDPOINT}", f"127.0.0.1:{9100 + index}"
+        )
+
+    def probe(endpoint):
+        return {"status": "ok", "queue_depth": int(endpoint[-1]) % 4,
+                "active_slots": 1}
+
+    registry = ReplicaRegistry(kv, tasks, probe=probe, probe_interval_s=0.0)
+    tracer = RaceTracer()
+    tracer.watch(registry, "registry")
+
+    def run_phase(name, body):
+        thread = threading.Thread(target=body, name=name, daemon=True)
+        thread.start()
+        thread.join(timeout=60.0)
+        assert not thread.is_alive(), f"phase {name} wedged"
+
+    try:
+        run_phase("fleet-refresh-0", lambda: registry.refresh(force=True))
+        for task in tasks:
+            tracer.watch(registry.get(task), f"replica[{task}]")
+        policy = LeastLoadedPolicy()
+
+        def reads():
+            for _ in range(8):
+                healthy = registry.healthy()
+                if healthy:
+                    policy.pick(healthy)
+                registry.snapshot()
+
+        for round_index in range(4):
+            run_phase(
+                f"fleet-fail-{round_index}",
+                lambda i=round_index: registry.report_failure(
+                    tasks[i % len(tasks)], ConnectionError("boom")
+                ),
+            )
+            run_phase(
+                f"fleet-refresh-{round_index + 1}",
+                lambda: registry.refresh(force=True),
+            )
+            run_phase(f"fleet-reads-{round_index}", reads)
+        races = tracer.races()
+        assert races == [], races
+        assert tracer.lock_cycles() == []
+    finally:
+        tracer.release()

@@ -1,0 +1,255 @@
+"""Targeted regressions for the TYA3xx findings fixed in this PR: every
+stop/close path survives concurrent and repeated invocation, the
+registry hands out replica copies, the heartbeat tombstone fires once,
+and the KV server join actually lands. The lint + lockset scenario
+suite in tests/test_analysis.py is the structural gate; these pin the
+user-visible behavior of each fix."""
+
+import threading
+
+import pytest
+
+from tf_yarn_tpu import event
+from tf_yarn_tpu.coordination.kv import InProcessKV, KVServer
+from tf_yarn_tpu.telemetry.heartbeat import Heartbeat
+
+
+def _hammer(fn, n_threads=4):
+    """Call `fn` from n threads at once; re-raise the first error."""
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def body():
+        barrier.wait(timeout=10.0)
+        try:
+            fn()
+        except BaseException as exc:  # noqa: TYA008 - re-raised below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=body, daemon=True) for _ in range(n_threads)
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=30.0)
+        assert not thread.is_alive(), "hammer thread wedged"
+    if errors:
+        raise errors[0]
+
+
+# --- scheduler + frontend lifecycle (TYA302 fixes) ------------------------
+
+def _paged_scheduler():
+    from tf_yarn_tpu.analysis.scenarios import make_paged_scheduler
+
+    return make_paged_scheduler()
+
+
+def test_slot_scheduler_concurrent_close_is_safe():
+    scheduler = _paged_scheduler()
+    scheduler.start()
+    _hammer(scheduler.close)
+    assert scheduler._thread is None
+    # and close() after close() stays a no-op
+    scheduler.close()
+
+
+def test_slot_scheduler_restart_after_close():
+    scheduler = _paged_scheduler()
+    scheduler.start()
+    scheduler.close()
+    scheduler.start()  # the swap left _thread None, so restart works
+    scheduler.close()
+
+
+def test_serving_server_concurrent_stop_is_safe():
+    from tf_yarn_tpu.serving.server import ServingServer
+
+    scheduler = _paged_scheduler()
+    server = ServingServer(scheduler)
+    server.start()
+    _hammer(server.stop)
+    assert server._thread is None
+    server.stop()  # idempotent
+
+
+def test_serving_server_start_is_idempotent():
+    from tf_yarn_tpu.serving.server import ServingServer
+
+    scheduler = _paged_scheduler()
+    server = ServingServer(scheduler)
+    endpoint = server.start()
+    assert server.start() == endpoint  # second start: same listener
+    server.stop()
+
+
+def test_rank_server_concurrent_stop_is_safe():
+    from tf_yarn_tpu.analysis.scenarios import _FakeRankEngine
+    from tf_yarn_tpu.ranking.scheduler import MicroBatchScheduler
+    from tf_yarn_tpu.ranking.server import RankServer
+
+    scheduler = MicroBatchScheduler(_FakeRankEngine(), params=None,
+                                    max_batch=4)
+    server = RankServer(scheduler)
+    server.start()
+    _hammer(server.stop)
+    assert server._thread is None
+    server.stop()
+
+
+def test_micro_batch_scheduler_concurrent_close_is_safe():
+    from tf_yarn_tpu.analysis.scenarios import _FakeRankEngine
+    from tf_yarn_tpu.ranking.scheduler import MicroBatchScheduler
+
+    scheduler = MicroBatchScheduler(_FakeRankEngine(), params=None,
+                                    max_batch=4)
+    scheduler.start()
+    _hammer(scheduler.close)
+    assert scheduler._thread is None
+    scheduler.close()
+
+
+def test_micro_batch_held_request_fails_on_close():
+    """The held-batch handoff now lives under _meta_lock; closing with a
+    request held must still answer it as shutdown (the PR 14 orphan
+    guarantee, re-proven on the locked path)."""
+    from tf_yarn_tpu.analysis.scenarios import _FakeRankEngine
+    from tf_yarn_tpu.ranking.scheduler import MicroBatchScheduler
+
+    scheduler = MicroBatchScheduler(
+        _FakeRankEngine(), params=None, max_batch=4, max_wait_ms=0.0
+    )
+    first = scheduler.submit([[1, 2, 3], [4, 5, 6], [7, 8, 9]])
+    second = scheduler.submit([[1, 1, 1], [2, 2, 2]])
+    scheduler.tick()  # scores first (3 rows), holds second (would be 5)
+    assert first.done
+    assert not second.done
+    stats = scheduler.stats()
+    assert stats["queued_rows"] == 2  # the held rows stay visible
+    scheduler.close()
+    assert second.done
+    assert second.finish_reason == "shutdown"
+
+
+def test_router_server_concurrent_stop_is_safe():
+    from tf_yarn_tpu.fleet.registry import ReplicaRegistry
+    from tf_yarn_tpu.fleet.router import RouterServer
+
+    registry = ReplicaRegistry(InProcessKV(), [],
+                               probe=lambda endpoint: {"status": "ok"})
+    server = RouterServer(registry)
+    server.start()
+    _hammer(server.stop)
+    assert server._thread is None
+    server.stop()
+
+
+# --- heartbeat (TYA302 fix + single tombstone) ----------------------------
+
+def test_heartbeat_concurrent_stop_single_tombstone(monkeypatch):
+    kv = InProcessKV()
+    tombstones = []
+    monkeypatch.setattr(
+        event, "heartbeat_stopped_event",
+        lambda kv_, task: tombstones.append(task),
+    )
+    heartbeat = Heartbeat(kv, "worker:0", every=30.0).start()
+    assert heartbeat._thread is not None
+    _hammer(heartbeat.stop)
+    assert heartbeat._thread is None
+    assert tombstones == ["worker:0"]  # exactly one, from the winner
+    heartbeat.stop()  # stop after stop: no second tombstone
+    assert tombstones == ["worker:0"]
+
+
+def test_heartbeat_stop_without_start_writes_no_tombstone(monkeypatch):
+    tombstones = []
+    monkeypatch.setattr(
+        event, "heartbeat_stopped_event",
+        lambda kv_, task: tombstones.append(task),
+    )
+    Heartbeat(InProcessKV(), "worker:1", every=30.0).stop()
+    assert tombstones == []
+
+
+# --- KV server (TYA303 fix) -----------------------------------------------
+
+def test_kv_server_stop_joins_acceptor_thread():
+    server = KVServer().start()
+    assert server._thread.is_alive()
+    server.stop()
+    assert not server._thread.is_alive()
+
+
+def test_kv_server_stop_before_start_does_not_raise():
+    KVServer().stop()
+
+
+# --- registry copies (TYA311 fix) -----------------------------------------
+
+def _healthy_registry():
+    from tf_yarn_tpu.fleet.registry import ReplicaRegistry
+
+    kv = InProcessKV()
+    kv.put_str(f"serving:0/{event.SERVING_ENDPOINT}", "127.0.0.1:9001")
+    registry = ReplicaRegistry(
+        kv, ["serving:0"],
+        probe=lambda endpoint: {"status": "ok", "queue_depth": 2,
+                                "active_slots": 1},
+        probe_interval_s=0.0,
+    )
+    registry.refresh(force=True)
+    return registry
+
+
+def test_registry_healthy_returns_copies():
+    registry = _healthy_registry()
+    (replica,) = registry.healthy()
+    replica.inflight = 99  # a policy-side mutation must not leak back
+    assert registry.get("serving:0").inflight == 0
+    # and the copies carry the real load signals
+    assert replica.queue_depth == 2
+    assert replica.active_slots == 1
+
+
+def test_registry_note_inflight_still_lands_on_the_live_replica():
+    registry = _healthy_registry()
+    registry.note_inflight("serving:0", 1)
+    assert registry.get("serving:0").inflight == 1
+    (replica,) = registry.healthy()
+    assert replica.inflight == 1
+
+
+# --- checkpoint staged-futures guard (TYA311 fix) -------------------------
+
+@pytest.mark.slow
+def test_checkpoint_wait_and_close_race_is_safe(tmp_path):
+    """wait() on one thread racing close() on another must neither drop
+    staged futures nor crash — the _staged_lock fix."""
+    import numpy as np
+
+    from tf_yarn_tpu.checkpoint import CheckpointWriter
+
+    state = {"w": np.zeros((4,), np.float32)}
+    writer = CheckpointWriter()
+    try:
+        writer.save(str(tmp_path), 1, state)
+        errors = []
+
+        def call(fn):
+            try:
+                fn()
+            except BaseException as exc:  # noqa: TYA008 - re-raised below
+                errors.append(exc)
+
+        waiter = threading.Thread(target=call, args=(writer.wait,),
+                                  daemon=True)
+        waiter.start()
+        writer.wait()
+        waiter.join(timeout=30.0)
+        assert not waiter.is_alive()
+        assert errors == []
+    finally:
+        writer.close()
+    assert (tmp_path / "ckpt-1").exists()

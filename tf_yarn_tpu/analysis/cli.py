@@ -1,10 +1,13 @@
-"""`python -m tf_yarn_tpu.analysis` — run all three engines, report, gate.
+"""`python -m tf_yarn_tpu.analysis` — run all four engines, report, gate.
 
 One invocation covers the whole stack: AST lints (TYA0xx), jaxpr-level
-entry-point verification (TYA1xx), and compiled-HLO artifact audits
-(TYA2xx) — `--hlo` narrows to the HLO engine alone, `--no-*` flags
-drop individual engines. Per-engine wall time is printed (and included
-in `--json`) so the tier-1 log shows where analysis time goes.
+entry-point verification (TYA1xx), compiled-HLO artifact audits
+(TYA2xx), and host-concurrency audits (TYA3xx: lock-discipline lint +
+dynamic lockset race scenarios) — `--hlo` / `--concurrency` narrow to
+one engine, `--no-*` flags drop individual engines, `--no-race` keeps
+the concurrency lint but skips the dynamic scenario drivers. Per-engine
+wall time is printed (and included in `--json`) so the tier-1 log shows
+where analysis time goes.
 
 Exit codes: 0 clean, 2 findings, 1 engine/usage error — distinct so CI
 can tell "the code has defects" from "the checker itself broke"
@@ -23,7 +26,8 @@ from tf_yarn_tpu.analysis.findings import Finding
 from tf_yarn_tpu.analysis.rules import RULES
 
 # Bumped whenever the --json document shape changes; consumers pin it.
-JSON_SCHEMA_VERSION = 2
+# v3: added the "race_report" section + the "concurrency" engine.
+JSON_SCHEMA_VERSION = 3
 
 EXIT_CLEAN = 0
 EXIT_ERROR = 1
@@ -35,7 +39,8 @@ def _parser() -> argparse.ArgumentParser:
         prog="python -m tf_yarn_tpu.analysis",
         description="JAX/TPU-aware static checker: AST lints (TYA0xx) + "
         "jaxpr entry-point verification (TYA1xx) + compiled-HLO artifact "
-        "audits (TYA2xx). Rule catalog: docs/StaticAnalysis.md.",
+        "audits (TYA2xx) + host-concurrency audits (TYA3xx). Rule "
+        "catalog: docs/StaticAnalysis.md.",
     )
     parser.add_argument(
         "paths", nargs="*", default=["tf_yarn_tpu"],
@@ -48,7 +53,12 @@ def _parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--hlo", action="store_true", dest="hlo_only",
-        help="run ONLY the compiled-HLO engine (skip AST + jaxpr)",
+        help="run ONLY the compiled-HLO engine (skip the others)",
+    )
+    parser.add_argument(
+        "--concurrency", action="store_true", dest="concurrency_only",
+        help="run ONLY the concurrency engine (lock-discipline lint + "
+        "lockset race scenarios)",
     )
     parser.add_argument(
         "--no-ast", action="store_true", help="skip the AST lint engine"
@@ -60,6 +70,15 @@ def _parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--no-hlo", action="store_true",
         help="skip the HLO engine (lower-and-compile audits)",
+    )
+    parser.add_argument(
+        "--no-concurrency", action="store_true",
+        help="skip the concurrency engine entirely",
+    )
+    parser.add_argument(
+        "--no-race", action="store_true",
+        help="keep the concurrency lint but skip the dynamic lockset "
+        "scenario drivers (fast lint-only mode)",
     )
     parser.add_argument(
         "--update-hlo-budgets", action="store_true",
@@ -112,15 +131,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{rule.summary}")
         return EXIT_CLEAN
 
-    run_ast = not args.no_ast and not args.hlo_only
-    run_jaxpr = not args.no_jaxpr and not args.hlo_only
-    run_hlo = not args.no_hlo
+    only = args.hlo_only or args.concurrency_only
+    run_ast = not args.no_ast and not only
+    run_jaxpr = not args.no_jaxpr and not only
+    run_hlo = not args.no_hlo and not args.concurrency_only
+    run_conc = (
+        args.concurrency_only
+        or (not args.no_concurrency and not args.hlo_only)
+    )
 
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     skipped: List[str] = []
     counts: Dict[str, Dict[str, int]] = {}
     hlo_census: Dict[str, Dict] = {}
+    race_report: Dict[str, Dict] = {}
     engine_seconds: Dict[str, float] = {}
     extra_axes = [a.strip() for a in args.axes.split(",") if a.strip()]
 
@@ -178,6 +203,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"({len(hlo_census)} entries)", file=sys.stderr,
             )
 
+    if run_conc:
+        from tf_yarn_tpu.analysis.concurrency import (
+            analyze_paths as analyze_concurrency,
+        )
+
+        started = time.monotonic()
+        try:
+            findings.extend(analyze_concurrency(args.paths))
+        except FileNotFoundError as exc:
+            print(f"error: no such path: {exc}", file=sys.stderr)
+            return EXIT_ERROR
+        except Exception as exc:
+            print(f"error: concurrency engine failed: {exc}",
+                  file=sys.stderr)
+            return EXIT_ERROR
+        if not args.no_race:
+            from tf_yarn_tpu.analysis.racecheck import run as run_racecheck
+
+            try:
+                race = run_racecheck()
+            except Exception as exc:
+                print(f"error: racecheck scenarios failed: {exc}",
+                      file=sys.stderr)
+                return EXIT_ERROR
+            findings.extend(race.findings)
+            suppressed.extend(race.suppressed)
+            race_report = race.report
+        engine_seconds["concurrency"] = round(time.monotonic() - started, 2)
+
     engines = "+".join(engine_seconds) or "no"
     if args.as_json:
         print(json.dumps({
@@ -186,6 +240,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "suppressed_findings": [f.to_json() for f in suppressed],
             "primitive_counts": counts,
             "hlo_census": hlo_census,
+            "race_report": race_report,
             "skipped_entries": skipped,
             "engine_seconds": engine_seconds,
             "n_findings": len(findings),

@@ -1,0 +1,430 @@
+"""Concurrency lint engine: lock-discipline rules over host-side classes.
+
+The static half of the TYA3xx layer (racecheck.py is the dynamic,
+Eraser-style half). Everything here is per-class and intentionally
+conservative — the rules only fire on shapes that are wrong with high
+confidence, because a lint the repo cannot pass is a lint that gets
+suppressed wholesale (the same posture as ast_engine.py):
+
+* **TYA301 unguarded-shared-write.** A class that owns a lock
+  (``self._lock = threading.Lock()/RLock()/Condition()``) establishes a
+  guard discipline for an attribute the moment ANY non-``__init__``
+  method writes it inside ``with self.<lock>:`` — after that, a write to
+  the same attribute outside the lock is flagged. A ``# guarded-by:
+  <lockattr>`` comment on the attribute's assignment line declares the
+  guard explicitly (and makes EVERY unguarded write a finding, even
+  before a guarded one exists). ``__init__``/``__post_init__`` writes
+  are exempt (the object is not shared yet), and methods whose name
+  ends in ``_locked`` are treated as lock-held by convention (they
+  document "caller holds the lock").
+
+* **TYA302 check-then-act-without-guard.** ``if self._thread: ...
+  self._thread.join()`` — the PR 9 orbax bug's exact shape. Flags an
+  ``if`` whose test reads a thread attribute (or a guarded attribute)
+  and whose body dereferences or rebinds it, when no guarding lock is
+  held. A body that only raises is fine (``if self._thread is not None:
+  raise`` is a start-twice guard, not a race), and the race-free
+  snapshot idiom (``thread, self._thread = self._thread, None`` then
+  testing the LOCAL) never matches.
+
+* **TYA303 thread-without-join.** ``self.X = threading.Thread(...)``
+  that gets ``.start()``ed but is never ``.join()``ed from any method
+  reachable from the owner's ``stop()``/``shutdown()``/``close()``
+  (one-hop helper calls are followed; joining a local captured from the
+  attribute counts).
+
+Suppression: ``# noqa: TYA30x`` per line (findings.noqa_lines), same as
+the AST engine. Dynamic findings (TYA311/TYA312) use per-scenario
+``allow=`` instead — see racecheck.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from tf_yarn_tpu.analysis.ast_engine import (
+    _collect_aliases,
+    _dotted,
+    _resolve,
+    discover_files,
+)
+from tf_yarn_tpu.analysis.findings import (
+    Finding,
+    apply_suppressions,
+    noqa_lines,
+)
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+}
+_THREAD_FACTORY = "threading.Thread"
+_INIT_METHODS = {"__init__", "__post_init__"}
+_STOPLIKE = re.compile(
+    r"stop|shutdown|close|join|terminate|quit|__exit__|__del__|atexit"
+)
+_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a plain ``self.x`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _flatten_targets(target: ast.AST):
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for element in target.elts:
+            yield from _flatten_targets(element)
+    else:
+        yield target
+
+
+def _written_attrs(stmt: ast.stmt):
+    """(attr, node) for every ``self.X = ...`` / ``self.X[...] = ...``
+    target of an assignment statement. Deeper chains (``self.x.y = ...``)
+    mutate a sub-object, not the attribute binding, and stay out of
+    scope — attribute-level discipline only."""
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return
+    for target in targets:
+        for element in _flatten_targets(target):
+            attr = _self_attr(element)
+            if attr is not None:
+                yield attr, element
+            elif isinstance(element, ast.Subscript):
+                attr = _self_attr(element.value)
+                if attr is not None:
+                    yield attr, element
+
+
+def _annotation_mentions_thread(node: ast.AST,
+                                aliases: Dict[str, str]) -> bool:
+    for sub in ast.walk(node):
+        if _resolve(_dotted(sub), aliases) == _THREAD_FACTORY:
+            return True
+    return False
+
+
+class _WriteSite:
+    __slots__ = ("attr", "method", "held", "node", "is_init", "locked")
+
+    def __init__(self, attr, method, held, node, is_init, locked):
+        self.attr = attr
+        self.method = method
+        self.held = held
+        self.node = node
+        self.is_init = is_init
+        self.locked = locked
+
+
+class _IfSite:
+    __slots__ = ("node", "method", "held", "is_init", "locked")
+
+    def __init__(self, node, method, held, is_init, locked):
+        self.node = node
+        self.method = method
+        self.held = held
+        self.is_init = is_init
+        self.locked = locked
+
+
+class _ClassAudit:
+    """One lock-owning class: collected facts + the TYA301-303 checks."""
+
+    def __init__(self, path: str, node: ast.ClassDef,
+                 aliases: Dict[str, str], source_lines: List[str]):
+        self.path = path
+        self.node = node
+        self.aliases = aliases
+        self.source_lines = source_lines
+        self.locks: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        self.annotations: Dict[str, str] = {}   # attr -> declared lock
+        self.writes: List[_WriteSite] = []
+        self.ifs: List[_IfSite] = []
+        self.thread_assign_lines: Dict[str, int] = {}
+        self.started_attrs: Set[str] = set()
+        self.joined_by_method: Dict[str, Set[str]] = {}
+        self.calls_by_method: Dict[str, Set[str]] = {}
+        self.method_names: Set[str] = set()
+        self._scan()
+
+    # -- collection ---------------------------------------------------------
+
+    def _methods(self):
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield item
+
+    def _scan(self) -> None:
+        # Pass 1: lock/thread attrs + explicit guarded-by annotations.
+        for fn in self._methods():
+            self.method_names.add(fn.name)
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = stmt.value
+                resolved = None
+                if isinstance(value, ast.Call):
+                    resolved = _resolve(_dotted(value.func), self.aliases)
+                for attr, node in _written_attrs(stmt):
+                    if resolved in _LOCK_FACTORIES:
+                        self.locks.add(attr)
+                    elif resolved == _THREAD_FACTORY:
+                        self.thread_attrs.add(attr)
+                        self.thread_assign_lines.setdefault(
+                            attr, node.lineno)
+                    elif (isinstance(stmt, ast.AnnAssign)
+                          and _annotation_mentions_thread(
+                              stmt.annotation, self.aliases)):
+                        self.thread_attrs.add(attr)
+                    line = self._line(node.lineno)
+                    match = _GUARDED_BY.search(line)
+                    if match:
+                        self.annotations[attr] = match.group(1)
+        if not self.locks and not self.thread_attrs:
+            return
+        # Pass 2: lock-context walk + call graph per method.
+        for fn in self._methods():
+            is_init = fn.name in _INIT_METHODS
+            locked = fn.name.endswith("_locked")
+            self._walk(fn.body, frozenset(), fn.name, is_init, locked)
+            self._scan_calls(fn)
+
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1]
+        return ""
+
+    def _with_locks(self, stmt) -> FrozenSet[str]:
+        acquired = set()
+        for item in stmt.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.locks:
+                acquired.add(attr)
+        return frozenset(acquired)
+
+    def _walk(self, stmts, held: FrozenSet[str], method: str,
+              is_init: bool, locked: bool) -> None:
+        for stmt in stmts:
+            for attr, node in _written_attrs(stmt):
+                self.writes.append(_WriteSite(
+                    attr, method, held, node, is_init, locked))
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = held | self._with_locks(stmt)
+                self._walk(stmt.body, inner, method, is_init, locked)
+            elif isinstance(stmt, ast.If):
+                self.ifs.append(_IfSite(stmt, method, held, is_init, locked))
+                self._walk(stmt.body, held, method, is_init, locked)
+                self._walk(stmt.orelse, held, method, is_init, locked)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                self._walk(stmt.body, held, method, is_init, locked)
+                self._walk(stmt.orelse, held, method, is_init, locked)
+            elif isinstance(stmt, ast.Try):
+                self._walk(stmt.body, held, method, is_init, locked)
+                for handler in stmt.handlers:
+                    self._walk(handler.body, held, method, is_init, locked)
+                self._walk(stmt.orelse, held, method, is_init, locked)
+                self._walk(stmt.finalbody, held, method, is_init, locked)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A closure runs later, possibly on another thread: the
+                # lexical lock context does not transfer.
+                self._walk(stmt.body, frozenset(), method, is_init, locked)
+            elif hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    self._walk(case.body, held, method, is_init, locked)
+
+    def _scan_calls(self, fn) -> None:
+        """Per-method: self-method calls, self.X.start(), and joins of
+        self.X (directly or via a local captured from it)."""
+        joins: Set[str] = set()
+        calls: Set[str] = set()
+        aliases: Dict[str, str] = {}  # local name -> thread attr
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign):
+                # thread = self._thread  /  thread, self._thread = self._thread, None
+                for target in stmt.targets:
+                    t_elts = list(_flatten_targets(target))
+                    if isinstance(stmt.value, ast.Tuple) \
+                            and len(t_elts) == len(stmt.value.elts):
+                        pairs = zip(t_elts, stmt.value.elts)
+                    else:
+                        pairs = [(el, stmt.value) for el in t_elts]
+                    for el, val in pairs:
+                        attr = _self_attr(val)
+                        if (isinstance(el, ast.Name)
+                                and attr in self.thread_attrs):
+                            aliases[el.id] = attr
+            if not isinstance(stmt, ast.Call):
+                continue
+            func = stmt.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            owner = func.value
+            owner_attr = _self_attr(owner)
+            if owner_attr is None and isinstance(owner, ast.Name):
+                owner_attr = aliases.get(owner.id)
+            if isinstance(owner, ast.Name) and owner.id == "self":
+                calls.add(func.attr)
+            elif owner_attr is not None:
+                if func.attr == "join":
+                    joins.add(owner_attr)
+                elif func.attr == "start":
+                    self.started_attrs.add(owner_attr)
+        self.joined_by_method[fn.name] = joins
+        self.calls_by_method[fn.name] = calls
+
+    # -- checks -------------------------------------------------------------
+
+    def _guard_map(self) -> Dict[str, Set[str]]:
+        """attr -> locks under which it is written (non-init, non-_locked
+        methods establish the discipline)."""
+        guards: Dict[str, Set[str]] = {}
+        for write in self.writes:
+            if write.is_init or write.attr in self.locks:
+                continue
+            if write.held:
+                guards.setdefault(write.attr, set()).update(write.held)
+        for attr, lock in self.annotations.items():
+            guards.setdefault(attr, set()).add(lock)
+        return guards
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        guards = self._guard_map()
+        cls = self.node.name
+        # TYA301
+        for write in self.writes:
+            if write.is_init or write.locked:
+                continue
+            required = guards.get(write.attr)
+            if not required or write.attr in self.locks:
+                continue
+            if write.held & required:
+                continue
+            locks = " or ".join(
+                f"'with self.{lock}'" for lock in sorted(required))
+            out.append(Finding(
+                "TYA301",
+                f"attribute '{write.attr}' of lock-owning class '{cls}' "
+                f"is written here without its guard ({locks} guards the "
+                "other writes); hold the lock, rename the method "
+                "'*_locked', or annotate the attribute",
+                self.path, write.node.lineno,
+                getattr(write.node, "col_offset", 0),
+            ))
+        # TYA302
+        interesting = set(self.thread_attrs) | set(guards)
+        for site in self.ifs:
+            if site.is_init or site.locked:
+                continue
+            if self._body_only_raises(site.node):
+                continue
+            tested = {
+                attr for sub in ast.walk(site.node.test)
+                for attr in [_self_attr(sub)] if attr
+            }
+            for attr in sorted(tested & interesting):
+                if attr in self.locks:
+                    continue
+                required = guards.get(attr, set())
+                if required and site.held & required:
+                    continue
+                if not self._body_acts_on(site.node, attr):
+                    continue
+                out.append(Finding(
+                    "TYA302",
+                    f"check-then-act on '{cls}.{attr}' without a guarding "
+                    "lock: another thread can rebind it between the test "
+                    "and the use; snapshot it to a local ('x, "
+                    f"self.{attr} = self.{attr}, None') or hold the lock",
+                    self.path, site.node.lineno,
+                    getattr(site.node, "col_offset", 0),
+                ))
+        # TYA303
+        stoplike = {
+            name for name in self.method_names if _STOPLIKE.search(name)
+        }
+        reachable = set(stoplike)
+        frontier = list(stoplike)
+        while frontier:
+            called = self.calls_by_method.get(frontier.pop(), set())
+            fresh = (called & self.method_names) - reachable
+            reachable |= fresh
+            frontier.extend(fresh)
+        joined = set()
+        for name in reachable:
+            joined |= self.joined_by_method.get(name, set())
+        for attr in sorted(self.started_attrs & self.thread_attrs):
+            if attr in joined:
+                continue
+            line = self.thread_assign_lines.get(attr, self.node.lineno)
+            out.append(Finding(
+                "TYA303",
+                f"thread attribute '{attr}' of '{cls}' is start()ed but "
+                "never joined from a stop()/close()/shutdown() path — "
+                "the owner can drop its last reference with the thread "
+                "still running",
+                self.path, line,
+            ))
+        return out
+
+    @staticmethod
+    def _body_only_raises(node: ast.If) -> bool:
+        return all(isinstance(stmt, ast.Raise) for stmt in node.body)
+
+    @staticmethod
+    def _body_acts_on(node: ast.If, attr: str) -> bool:
+        """The if-body dereferences (``self.X.y``/``self.X[...]``) or
+        rebinds ``self.X`` — the 'act' half of check-then-act. A bare
+        re-read is the snapshot idiom and does not count."""
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Attribute, ast.Subscript)) \
+                        and _self_attr(sub.value) == attr:
+                    return True
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    if any(a == attr for a, _ in _written_attrs(sub)):
+                        return True
+        return False
+
+
+def _audit_source(path: str, source: str) -> List[Finding]:
+    tree = ast.parse(source, filename=path)
+    aliases = _collect_aliases(tree)
+    lines = source.splitlines()
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            audit = _ClassAudit(path, node, aliases, lines)
+            if audit.locks or audit.thread_attrs:
+                findings.extend(audit.findings())
+    return findings
+
+
+def analyze_paths(paths: Sequence[str]) -> List[Finding]:
+    """Run the TYA301-303 lint over every .py under `paths`; returns
+    noqa-filtered findings, sorted like the AST engine's."""
+    findings: List[Finding] = []
+    for path in discover_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            file_findings = _audit_source(path, source)
+        except (OSError, SyntaxError) as exc:
+            findings.append(
+                Finding("TYA000", f"could not parse: {exc}", path))
+            continue
+        findings.extend(
+            apply_suppressions(file_findings, noqa_lines(source)))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.code))

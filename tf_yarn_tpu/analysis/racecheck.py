@@ -1,0 +1,437 @@
+"""Eraser-style dynamic lockset race checker (the TYA31x half).
+
+`RaceTracer` instruments a LIVE object graph: `watch(obj, name)` swaps
+the object's class for a dynamic subclass whose ``__getattribute__`` /
+``__setattr__`` record every data-attribute access as ``(thread, attr,
+locks_held)``, and every ``threading.Lock``/``RLock`` in the instance
+dict is replaced by a :class:`TracedLock` proxy so ``with self._lock:``
+transparently feeds the per-thread held-lock set and the lock-
+acquisition-order graph.
+
+The per-variable state machine is lockset refinement with a single
+ownership transfer (the standard fix for Eraser's init-then-handoff
+false positives):
+
+* exclusive(owner) — one thread has touched the variable; no checking.
+* first access by a second thread transfers ownership once (the
+  constructor built the object, a worker now owns it).
+* any later access by ANOTHER thread begins shared tracking: the
+  candidate lockset C(v) starts as the intersection of the locks held
+  at this and the previous access, every subsequent access refines
+  ``C(v) &= locks_held``, and the variable reports the moment C(v) is
+  empty while a write has occurred — a candidate race, with both
+  access sites (TYA311).
+
+Crucially this keys on THREAD IDENTITY, not timing: the scenario
+drivers (scenarios.py) can run their threads strictly sequentially —
+spawn, drive, join, next — and still detect every lockset violation,
+so the suite is deterministic by construction (zero flake in tier-1).
+
+Lock-order: each acquisition while other traced locks are held adds
+edges ``held -> acquired``; a cycle in that graph is a potential
+deadlock (TYA312) even if no execution ever interleaved into it.
+
+Known limitations (documented in docs/StaticAnalysis.md): Event/
+Condition/queue.Queue synchronization and thread joins are invisible
+to locksets (accesses they order can still report — that is what
+per-scenario ``allow=`` with a justification is for), and objects
+using ``__slots__`` cannot be class-swapped (watch their owner
+instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from tf_yarn_tpu.analysis.findings import Finding
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+_MARKER = "__race_tracer__"
+_SELF_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _site(skip_frames: int = 2, depth: int = 3) -> str:
+    """Compact call-site string (innermost first), skipping this
+    module's own frames — the 'stack trace' attached to each access."""
+    frame = sys._getframe(skip_frames)
+    parts: List[str] = []
+    while frame is not None and len(parts) < depth:
+        filename = frame.f_code.co_filename
+        if not filename.startswith(_SELF_DIR) \
+                or os.path.basename(filename) not in (
+                    "racecheck.py",):
+            parts.append(
+                f"{os.path.basename(filename)}:{frame.f_lineno} "
+                f"in {frame.f_code.co_name}"
+            )
+        frame = frame.f_back
+    return " < ".join(parts)
+
+
+class TracedLock:
+    """Lock/RLock proxy feeding the tracer's held-set and order graph."""
+
+    __slots__ = ("_inner", "name", "_tracer")
+
+    def __init__(self, inner, name: str, tracer: "RaceTracer"):
+        self._inner = inner
+        self.name = name
+        self._tracer = tracer
+
+    def acquire(self, *args, **kwargs):
+        acquired = self._inner.acquire(*args, **kwargs)
+        if acquired:
+            self._tracer._note_acquire(self)
+        return acquired
+
+    def release(self):
+        self._tracer._note_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _Access:
+    __slots__ = ("thread", "is_write", "lockset", "site")
+
+    def __init__(self, thread, is_write, lockset, site):
+        self.thread = thread
+        self.is_write = is_write
+        self.lockset = lockset
+        self.site = site
+
+
+class _VarState:
+    __slots__ = ("owner", "transferred", "shared", "lockset",
+                 "written", "last", "reported")
+
+    def __init__(self, owner: str):
+        self.owner = owner
+        self.transferred = False
+        self.shared = False
+        self.lockset: Optional[frozenset] = None
+        self.written = False
+        self.last: Optional[_Access] = None
+        self.reported = False
+
+
+class RaceTracer:
+    """Watches objects, records accesses, reports lockset violations
+    and lock-order cycles. `release()` restores every watched object."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()           # leaf lock: records only
+        self._tls = threading.local()
+        self._vars: Dict[Tuple[int, str], _VarState] = {}
+        self._watched: List[Tuple[Any, type, Dict[str, Any]]] = []
+        self._names: Dict[int, str] = {}
+        self._edges: Dict[str, Set[str]] = {}
+        self._races: List[Dict[str, Any]] = []
+        self._threads: Set[str] = set()
+        self.n_accesses = 0
+        self._class_cache: Dict[type, type] = {}
+
+    # -- watching -----------------------------------------------------------
+
+    def watch(self, obj: Any, name: str) -> Any:
+        """Instrument `obj` (a plain-``__dict__`` instance) in place;
+        returns it. Lock-valued attributes become TracedLocks named
+        ``<name>.<attr>``."""
+        if getattr(type(obj), "__slots__", None) is not None \
+                and not hasattr(obj, "__dict__"):
+            raise TypeError(
+                f"cannot watch {type(obj).__name__}: __slots__ classes "
+                "have no swappable instance dict"
+            )
+        replaced: Dict[str, Any] = {}
+        for attr, value in list(obj.__dict__.items()):
+            if isinstance(value, _LOCK_TYPES):
+                replaced[attr] = value
+                obj.__dict__[attr] = TracedLock(
+                    value, f"{name}.{attr}", self)
+        obj.__dict__[_MARKER] = self
+        self._names[id(obj)] = name
+        self._watched.append((obj, obj.__class__, replaced))
+        obj.__class__ = self._traced_class(obj.__class__)
+        return obj
+
+    def release(self) -> None:
+        """Undo every watch: original classes and raw locks restored."""
+        for obj, orig_class, replaced in self._watched:
+            obj.__class__ = orig_class
+            obj.__dict__.pop(_MARKER, None)
+            for attr, lock in replaced.items():
+                obj.__dict__[attr] = lock
+        self._watched.clear()
+
+    def _traced_class(self, cls: type) -> type:
+        cached = self._class_cache.get(cls)
+        if cached is not None:
+            return cached
+
+        def __getattribute__(inst, attr):
+            value = object.__getattribute__(inst, attr)
+            if attr.startswith("__"):
+                return value
+            d = object.__getattribute__(inst, "__dict__")
+            tracer = d.get(_MARKER)
+            if tracer is not None and attr in d \
+                    and not isinstance(value, TracedLock):
+                tracer._record(inst, attr, is_write=False)
+            return value
+
+        def __setattr__(inst, attr, value):
+            d = object.__getattribute__(inst, "__dict__")
+            tracer = d.get(_MARKER)
+            if tracer is not None and not attr.startswith("__"):
+                tracer._record(inst, attr, is_write=True)
+            object.__setattr__(inst, attr, value)
+
+        traced = type(
+            f"Traced{cls.__name__}", (cls,),
+            {"__getattribute__": __getattribute__,
+             "__setattr__": __setattr__},
+        )
+        self._class_cache[cls] = traced
+        return traced
+
+    # -- lock bookkeeping ---------------------------------------------------
+
+    def _held(self) -> List[TracedLock]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, lock: TracedLock) -> None:
+        held = self._held()
+        if held:
+            with self._mu:
+                for outer in held:
+                    if outer.name != lock.name:
+                        self._edges.setdefault(
+                            outer.name, set()).add(lock.name)
+        held.append(lock)
+
+    def _note_release(self, lock: TracedLock) -> None:
+        held = self._held()
+        for index in range(len(held) - 1, -1, -1):
+            if held[index] is lock:
+                del held[index]
+                return
+
+    # -- the lockset state machine ------------------------------------------
+
+    def _record(self, obj: Any, attr: str, is_write: bool) -> None:
+        thread = threading.current_thread().name
+        lockset = frozenset(lock.name for lock in self._held())
+        access = _Access(thread, is_write, lockset,
+                         _site(skip_frames=3))
+        key = (id(obj), attr)
+        with self._mu:
+            self.n_accesses += 1
+            self._threads.add(thread)
+            state = self._vars.get(key)
+            if state is None:
+                state = self._vars[key] = _VarState(thread)
+                state.written = is_write
+                state.last = access
+                return
+            if state.reported:
+                return
+            if not state.shared:
+                if thread == state.owner:
+                    state.written |= is_write
+                    state.last = access
+                    return
+                if not state.transferred:
+                    # init-then-handoff: the constructor thread built it,
+                    # a worker owns it now. One transfer only.
+                    state.transferred = True
+                    state.owner = thread
+                    state.written = is_write
+                    state.last = access
+                    return
+                state.shared = True
+                state.lockset = lockset & state.last.lockset
+                state.written |= is_write
+            else:
+                state.lockset &= lockset
+                state.written |= is_write
+            if state.written and not state.lockset:
+                state.reported = True
+                previous = state.last
+                self._races.append({
+                    "var": f"{self._names.get(id(obj), '?')}.{attr}",
+                    "kind": "write" if (is_write or previous.is_write)
+                            else "read",
+                    "thread_a": previous.thread,
+                    "locks_a": sorted(previous.lockset),
+                    "write_a": previous.is_write,
+                    "site_a": previous.site,
+                    "thread_b": thread,
+                    "locks_b": sorted(lockset),
+                    "write_b": is_write,
+                    "site_b": access.site,
+                })
+            state.last = access
+
+    # -- reports ------------------------------------------------------------
+
+    def races(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return list(self._races)
+
+    def threads_seen(self) -> int:
+        with self._mu:
+            return len(self._threads)
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Simple cycles in the acquisition-order graph, canonicalized
+        (rotated to start at the smallest name) and deduplicated."""
+        with self._mu:
+            graph = {node: sorted(nxt) for node, nxt in self._edges.items()}
+        cycles: Set[Tuple[str, ...]] = set()
+
+        def visit(node: str, path: List[str], on_path: Set[str]):
+            for nxt in graph.get(node, ()):
+                if nxt in on_path:
+                    cycle = path[path.index(nxt):]
+                    pivot = cycle.index(min(cycle))
+                    cycles.add(tuple(cycle[pivot:] + cycle[:pivot]))
+                    continue
+                visit(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            visit(start, [start], {start})
+        return [list(cycle) for cycle in sorted(cycles)]
+
+
+# --------------------------------------------------------------------------
+# Scenarios
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One deterministic driver over real objects. `run(tracer)` builds
+    the object graph, calls ``tracer.watch(...)`` on the hot objects,
+    and drives them from ≥ 2 threads (sequential phases are fine — the
+    lockset machine keys on thread identity, not interleaving).
+
+    `allow` suppresses known-benign candidate races: ``(pattern,
+    justification)`` pairs, fnmatch-ed against the race's ``var``
+    (e.g. ``("scheduler._ticks", "single-writer advisory counter")``).
+    Suppressed races surface in `suppressed_findings`, never vanish.
+    """
+
+    name: str
+    run: Callable[[RaceTracer], None]
+    allow: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclasses.dataclass
+class ScenarioReport:
+    name: str
+    findings: List[Finding]
+    suppressed: List[Finding]
+    races: List[Dict[str, Any]]
+    cycles: List[List[str]]
+    n_accesses: int
+    n_threads: int
+    seconds: float
+
+
+def run_scenario(scenario: Scenario) -> ScenarioReport:
+    tracer = RaceTracer()
+    started = time.monotonic()
+    try:
+        scenario.run(tracer)
+    finally:
+        tracer.release()
+    seconds = round(time.monotonic() - started, 3)
+    path = f"<scenario:{scenario.name}>"
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for race in tracer.races():
+        message = (
+            f"candidate data race on {race['var']}: "
+            f"{'write' if race['write_a'] else 'read'} by "
+            f"{race['thread_a']} holding {race['locks_a'] or 'no locks'} "
+            f"[{race['site_a']}] vs "
+            f"{'write' if race['write_b'] else 'read'} by "
+            f"{race['thread_b']} holding {race['locks_b'] or 'no locks'} "
+            f"[{race['site_b']}] — empty lockset intersection"
+        )
+        reason = _allowed(scenario.allow, race["var"])
+        if reason is not None:
+            suppressed.append(Finding(
+                "TYA311", f"{message} [allowed: {reason}]", path))
+        else:
+            findings.append(Finding("TYA311", message, path))
+    for cycle in tracer.lock_cycles():
+        findings.append(Finding(
+            "TYA312",
+            "lock-acquisition-order cycle (potential deadlock): "
+            + " -> ".join(cycle + [cycle[0]]),
+            path,
+        ))
+    return ScenarioReport(
+        scenario.name, findings, suppressed, tracer.races(),
+        tracer.lock_cycles(), tracer.n_accesses, tracer.threads_seen(),
+        seconds,
+    )
+
+
+def _allowed(allow: Tuple[Tuple[str, str], ...],
+             var: str) -> Optional[str]:
+    for pattern, reason in allow:
+        if fnmatch.fnmatch(var, pattern):
+            return reason
+    return None
+
+
+@dataclasses.dataclass
+class RaceCheckReport:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    report: Dict[str, Any]   # the --json `race_report` section
+
+
+def run(scenarios: Optional[List[Scenario]] = None) -> RaceCheckReport:
+    """Run the scenario suite (default: scenarios.default_scenarios());
+    aggregate findings + the JSON race_report section."""
+    if scenarios is None:
+        from tf_yarn_tpu.analysis.scenarios import default_scenarios
+
+        scenarios = default_scenarios()
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    report: Dict[str, Any] = {}
+    for scenario in scenarios:
+        result = run_scenario(scenario)
+        findings.extend(result.findings)
+        suppressed.extend(result.suppressed)
+        report[result.name] = {
+            "accesses": result.n_accesses,
+            "threads": result.n_threads,
+            "races": len(result.races),
+            "suppressed": len(result.suppressed),
+            "lock_cycles": result.cycles,
+            "seconds": result.seconds,
+        }
+    return RaceCheckReport(findings, suppressed, report)

@@ -141,3 +141,37 @@ _register(
     "distinct cache keys across ticks whose tables/lengths/tokens are "
     "supposed to be traced — serving recompiles mid-flight", "hlo",
 )
+
+# --- concurrency audits --------------------------------------------------
+_register(
+    "TYA301", "unguarded-shared-write",
+    "an attribute of a lock-owning class is written both inside and "
+    "outside its guarding ``with self.<lock>`` blocks — one code path "
+    "updates shared state without the discipline the others follow",
+    "concurrency",
+)
+_register(
+    "TYA302", "check-then-act-without-guard",
+    "``if self._thread: self._thread.join()``-style test and use of "
+    "shared state with no guard held across the pair — the exact shape "
+    "of the orbax wait_until_finished race (PR 9)", "concurrency",
+)
+_register(
+    "TYA303", "thread-without-join",
+    "a thread attribute is started but never joined from any stop()/"
+    "shutdown()/close()-like method — shutdown can't prove the worker "
+    "exited before teardown proceeds", "concurrency",
+)
+_register(
+    "TYA311", "lockset-empty-race",
+    "dynamic lockset checker: two threads touched the same attribute "
+    "(at least one write) and the intersection of locks held across "
+    "all accesses is empty — a candidate data race, reported with both "
+    "call sites", "concurrency",
+)
+_register(
+    "TYA312", "lock-order-cycle",
+    "dynamic lock-order audit: the runtime lock-acquisition graph "
+    "contains a cycle (lock A held while taking B and B held while "
+    "taking A) — a potential deadlock", "concurrency",
+)

@@ -612,6 +612,14 @@ class CheckpointWriter:
         self._executor = None  # staged-upload worker, created on demand
         self._finalizer = None  # manifest writer for async direct saves
         self._staged_futures: list = []
+        # Guards _staged_futures only: appended by the train thread,
+        # drained by wait()/close() — close() may run on a different
+        # thread (driver shutdown), and an unguarded list rebind there
+        # can drop futures or re-raise a settled error (found by the
+        # TYA311 lockset scenario suite). Never held while blocking on
+        # a future: the worker threads never take it, so ordering is
+        # deadlock-free by construction.
+        self._staged_lock = threading.Lock()
         self._last_submitted: Optional[Tuple[str, int]] = None
         # Serializes every _ckptr interaction: orbax's AsyncManager
         # .wait_until_finished is check-then-join on its worker-thread
@@ -640,7 +648,9 @@ class CheckpointWriter:
 
                 with self._ckptr_lock:
                     self._ckptr.wait_until_finished()
-                concurrent.futures.wait(self._staged_futures)
+                with self._staged_lock:
+                    staged = list(self._staged_futures)
+                concurrent.futures.wait(staged)
             self._last_submitted = (model_dir, step)
             self._gc(model_dir)
             state = _canonicalize_for_save(state)
@@ -674,9 +684,11 @@ class CheckpointWriter:
             self._finalizer = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-manifest"
             )
-        self._staged_futures.append(
-            self._finalizer.submit(self._finalize_direct, model_dir, step)
+        future = self._finalizer.submit(
+            self._finalize_direct, model_dir, step
         )
+        with self._staged_lock:
+            self._staged_futures.append(future)
 
     def _finalize_direct(self, model_dir: str, step: int) -> None:
         # Blocks until every in-flight orbax save (>= this step) has
@@ -712,23 +724,31 @@ class CheckpointWriter:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-stage"
             )
-        self._staged_futures.append(
-            self._executor.submit(_write_staged, model_dir, step, holder)
+        future = self._executor.submit(
+            _write_staged, model_dir, step, holder
         )
+        with self._staged_lock:
+            self._staged_futures.append(future)
 
     def _collect_staged_errors(self, block: bool):
         """First failure of a background staged save, or None. Settled
         futures leave the queue even when failing, so one failure is
         reported once — not re-surfaced by every later call."""
+        with self._staged_lock:
+            futures, self._staged_futures = self._staged_futures, []
         pending, errors = [], []
-        for future in self._staged_futures:
+        for future in futures:
             if block or future.done():
                 exc = future.exception()  # waits when block=True
                 if exc is not None:
                     errors.append(exc)
             else:
                 pending.append(future)
-        self._staged_futures = pending
+        if pending:
+            # Futures submitted while we were draining stay queued; ours
+            # go back in front to preserve submission order.
+            with self._staged_lock:
+                self._staged_futures[:0] = pending
         return errors[0] if errors else None
 
     def _raise_staged_errors(self, block: bool) -> None:

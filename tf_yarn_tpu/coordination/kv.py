@@ -271,8 +271,21 @@ class KVServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() waits on serve_forever's is-shut-down event, which
+        # starts UNSET — calling it when the acceptor never ran blocks
+        # forever (stdlib BaseServer semantics). A dead/finished thread
+        # means serve_forever already exited (event set), so shutdown()
+        # then returns immediately.
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
+        # shutdown() only signals serve_forever; without the join the
+        # acceptor thread can still be mid-poll when the caller tears
+        # down the process state it reads (TYA303).
+        try:
+            self._thread.join(timeout=5.0)
+        except RuntimeError:
+            pass  # stop() before start(): nothing to join
 
 
 class KVClient(KVStore):

@@ -358,9 +358,16 @@ class ReplicaRegistry:
     def healthy(self, kind: Optional[str] = None) -> List[Replica]:
         """The routable set, optionally restricted to one capability
         kind — the router passes the kind its request path demands, so
-        a /v1/rank request can never land on a generate replica."""
+        a /v1/rank request can never land on a generate replica.
+
+        Returns per-call COPIES made under the lock: routing policies
+        read load fields lock-free on their own threads, and a live
+        Replica could be half-mutated by a concurrent refresh probe
+        (the lockset scenario suite gates this)."""
         with self._lock:
-            return self._healthy_locked(kind)
+            return [
+                dataclasses.replace(r) for r in self._healthy_locked(kind)
+            ]
 
     def get(self, task: str) -> Optional[Replica]:
         with self._lock:

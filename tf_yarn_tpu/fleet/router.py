@@ -134,6 +134,7 @@ class RouterServer:
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -146,19 +147,25 @@ class RouterServer:
         return f"{host}:{self.port}"
 
     def start(self) -> str:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="router-http", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._httpd.serve_forever, name="router-http",
+                    daemon=True,
+                )
+                self._thread.start()
         _logger.info("router frontend listening on %s", self.endpoint)
         return self.endpoint
 
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        # Snapshot-under-lock: concurrent stop() calls each either own
+        # the thread (and join it) or see None; join outside the lock.
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
 
     # -- accounting ---------------------------------------------------------
 

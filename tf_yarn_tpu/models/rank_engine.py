@@ -316,7 +316,8 @@ class RankEngine:
         dropped), the bucketed executable runs, and the ONE host sync —
         `np.asarray` on the scores — ends the tick.
         """
-        self.stats["calls"] += 1
+        with self._lock:
+            self.stats["calls"] += 1
         params = self.place_params(params)
         cat, dense = self.feature_arrays(cat, dense)
         batch = cat.shape[0]
@@ -324,7 +325,8 @@ class RankEngine:
             raise ValueError("cannot rank an empty batch")
         bucket = self.select_bucket(batch)
         if bucket not in self.batch_buckets:
-            self.stats["unbucketed_shapes"] += 1
+            with self._lock:
+                self.stats["unbucketed_shapes"] += 1
             _logger.warning(
                 "rank batch %d beyond the bucket grid %s: exact-shape "
                 "compile", batch, self.batch_buckets,

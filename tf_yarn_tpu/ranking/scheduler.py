@@ -157,6 +157,7 @@ class MicroBatchScheduler:
         self._draining = False
         self._work = threading.Event()
         self._stop = threading.Event()
+        self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._registry = telemetry.get_registry()
         nbytes = 0
@@ -227,9 +228,10 @@ class MicroBatchScheduler:
         with self._meta_lock:
             rows = self._queued_rows
             oldest = self._oldest_wait[0] if self._oldest_wait else None
-        if self._held is not None:
-            rows += self._held[0].batch
+            held = self._held
             held_at = self._held_since
+        if held is not None:
+            rows += held[0].batch
             oldest = held_at if oldest is None else min(oldest, held_at)
         if rows <= 0:
             return False, IDLE_POLL_S
@@ -251,10 +253,10 @@ class MicroBatchScheduler:
         rows = 0
         with telemetry.span("ranking/tick") as tick_span:
             while True:
-                if self._held is not None:
+                with self._meta_lock:
                     item, self._held = self._held, None
                     self._held_since = None
-                else:
+                if item is None:
                     item = self.queue.pop()
                     if item is not None:
                         self._note_popped(item[0])
@@ -265,8 +267,9 @@ class MicroBatchScheduler:
                     self._finish_unadmitted(response, FINISH_DEADLINE)
                     continue
                 if rows + request.batch > self.max_batch:
-                    self._held = item
-                    self._held_since = now
+                    with self._meta_lock:
+                        self._held = item
+                        self._held_since = now
                     break
                 batch.append(item)
                 rows += request.batch
@@ -325,13 +328,14 @@ class MicroBatchScheduler:
     # -- loop ---------------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("scheduler already started")
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="ranking-scheduler", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is not None:
+                raise RuntimeError("scheduler already started")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="ranking-scheduler", daemon=True
+            )
+            self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -355,11 +359,11 @@ class MicroBatchScheduler:
             self._work.clear()
 
     def _fail_inflight(self, reason: str) -> None:
-        if self._held is not None:
-            _request, response = self._held
-            self._held = None
+        with self._meta_lock:
+            held, self._held = self._held, None
             self._held_since = None
-            self._finish_unadmitted(response, reason)
+        if held is not None:
+            self._finish_unadmitted(held[1], reason)
         for request, response in self.queue.drain():
             self._note_popped(request)
             self._finish_unadmitted(response, reason)
@@ -382,9 +386,13 @@ class MicroBatchScheduler:
         self._draining = True
         self._stop.set()
         self._work.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30.0)
-            self._thread = None
+        # Snapshot-under-lock: concurrent close() calls each either own
+        # the loop thread (and join it) or see None; join outside the
+        # lock so a wedged loop can't deadlock start().
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=30.0)
         self._fail_inflight(FINISH_SHUTDOWN)
 
     # -- introspection -------------------------------------------------------
@@ -395,8 +403,9 @@ class MicroBatchScheduler:
         with self._meta_lock:
             queued_rows = self._queued_rows
             requests_total = self._requests_total
-        if self._held is not None:
-            queued_rows += self._held[0].batch
+            held = self._held
+        if held is not None:
+            queued_rows += held[0].batch
         snap = {
             "max_batch": self.max_batch,
             "max_wait_ms": self.max_wait_ms,

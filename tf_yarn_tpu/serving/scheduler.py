@@ -287,6 +287,7 @@ class SlotScheduler:
         self._draining = False
         self._work = threading.Event()
         self._stop = threading.Event()
+        self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._registry = telemetry.get_registry()
         # max context the model's KV cache can hold, when the engine
@@ -916,13 +917,14 @@ class SlotScheduler:
     # -- loop ---------------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("scheduler already started")
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="serving-scheduler", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is not None:
+                raise RuntimeError("scheduler already started")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="serving-scheduler", daemon=True
+            )
+            self._thread.start()
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -974,9 +976,14 @@ class SlotScheduler:
         self._draining = True
         self._stop.set()
         self._work.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30.0)
-            self._thread = None
+        # Snapshot-under-lock: concurrent close() calls each either own
+        # the loop thread (and join it) or see None — the PR 9 orbax
+        # check-then-join shape, fixed at the source this time. The join
+        # stays outside the lock so a wedged loop can't deadlock start().
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=30.0)
         self._fail_inflight(FINISH_SHUTDOWN)
 
     # -- introspection -------------------------------------------------------

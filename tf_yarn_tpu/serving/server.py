@@ -51,6 +51,7 @@ class ServingServer:
         handler = _make_handler(scheduler)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.scheduler = scheduler
 
@@ -64,19 +65,26 @@ class ServingServer:
         return f"{host}:{self.port}"
 
     def start(self) -> str:
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="serving-http", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._httpd.serve_forever, name="serving-http",
+                    daemon=True,
+                )
+                self._thread.start()
         _logger.info("serving frontend listening on %s", self.endpoint)
         return self.endpoint
 
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        # Snapshot-under-lock so concurrent stop() calls can't both join
+        # a half-cleared reference; the join itself stays outside the
+        # lock (never block other lifecycle calls on a 10s wait).
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)
 
 
 def _make_handler(scheduler: SlotScheduler):

@@ -57,6 +57,7 @@ class Heartbeat:
         self._every = float(every)
         self._registry = registry
         self._stop = threading.Event()
+        self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.beats = 0
 
@@ -86,22 +87,27 @@ class Heartbeat:
             self._beat()
 
     def start(self) -> "Heartbeat":
-        if self.enabled and self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, name=f"heartbeat-{self._task}", daemon=True
-            )
-            self._thread.start()
+        with self._lifecycle:
+            if self.enabled and self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name=f"heartbeat-{self._task}",
+                    daemon=True,
+                )
+                self._thread.start()
         return self
 
     def stop(self) -> None:
-        was_running = self._thread is not None
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        if was_running:
-            # Tombstone on clean shutdown: a finished task and a dead one
-            # both stop beating — the watchdog must only hunt the latter.
+        # Snapshot-under-lock: concurrent stop() calls each either own
+        # the beater (join it, write the tombstone once) or see None.
+        with self._lifecycle:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+            # Tombstone on clean shutdown (exactly once — only the
+            # stop() that won the snapshot): a finished task and a dead
+            # one both stop beating — the watchdog must only hunt the
+            # latter.
             from tf_yarn_tpu import event
 
             try:

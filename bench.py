@@ -411,6 +411,19 @@ def _stale_tpu_fields() -> dict:
         fields["last_tpu_serve_chunked_itl_p95_ratio"] = chunked_ab[
             "itl_p95_ratio"
         ]
+    overload_ab = serve.get("overload") or {}
+    for row_name, row in (overload_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "peak_streams" in row:
+            fields[f"last_tpu_serve_overload_{row_name}_peak_streams"] = (
+                row["peak_streams"]
+            )
+            fields[
+                f"last_tpu_serve_overload_{row_name}"
+                "_interactive_ttft_p95_ms"
+            ] = row.get("interactive_ttft_p95_ms")
+    for key in ("peak_streams_ratio", "interactive_ttft_p95_ratio"):
+        if key in overload_ab:
+            fields[f"last_tpu_serve_overload_{key}"] = overload_ab[key]
     fleet = table.get("fleet") or {}
     for row_name, row in (fleet.get("rows") or {}).items():
         if isinstance(row, dict) and "tokens_per_sec" in row:
@@ -674,7 +687,8 @@ def bench_flagship_train():
         except Exception as exc:
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
         try:
-            serve = suite.bench_serve(tpu=True, tp=True, chunked=True)
+            serve = suite.bench_serve(tpu=True, tp=True, chunked=True,
+                                      overload=True)
             ab["serve"] = serve
             _write_ab(ab)
             # Online-serving headline pair: continuous-batching
@@ -747,6 +761,27 @@ def bench_flagship_train():
                 result["serve_chunked_itl_p95_ratio"] = chunked_ab[
                     "itl_p95_ratio"
                 ]
+            # KV-oversubscription A/B: hold-until-free vs suspend-to-
+            # host on the overload trace — peak streams is the capacity
+            # claim, interactive TTFT p95 the SLO it must not cost,
+            # streams_match_hold the bit-identity evidence.
+            overload_ab = serve.get("overload") or {}
+            for row_name, row in (overload_ab.get("rows") or {}).items():
+                if isinstance(row, dict) and "peak_streams" in row:
+                    result[f"serve_overload_{row_name}_peak_streams"] = (
+                        row["peak_streams"]
+                    )
+                    result[
+                        f"serve_overload_{row_name}_interactive_ttft_p95_ms"
+                    ] = row.get("interactive_ttft_p95_ms")
+            for key in ("peak_streams_ratio", "interactive_ttft_p95_ratio"):
+                if key in overload_ab:
+                    result[f"serve_overload_{key}"] = overload_ab[key]
+            suspend_row = (overload_ab.get("rows") or {}).get(
+                "suspend") or {}
+            for key in ("suspends", "resumes", "streams_match_hold"):
+                if key in suspend_row:
+                    result[f"serve_overload_{key}"] = suspend_row[key]
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
@@ -822,7 +857,8 @@ def _record_cpu_serve_ab(result: dict) -> None:
     line."""
     try:
         suite = _load_bench_suite()
-        serve = suite.bench_serve(tpu=False, tp=True, chunked=True)
+        serve = suite.bench_serve(tpu=False, tp=True, chunked=True,
+                                  overload=True)
     except Exception as exc:  # the bench headline must still print
         _log(f"cpu serve bench FAILED: {type(exc).__name__}: {exc}")
         return
@@ -882,6 +918,24 @@ def _record_cpu_serve_ab(result: dict) -> None:
         result["serve_cpu_chunked_streams_match_blocking"] = chunked_ab[
             "rows"
         ]["chunked"]["streams_match_blocking"]
+    # KV-oversubscription A/B: peak-streams ratio and the bit-identity
+    # flag are scheduling properties and hold anywhere; the CPU rig's
+    # TTFT/goodput numbers are device-shaped and are NOT recorded as
+    # speed evidence (the section's note says so).
+    overload_ab = serve.get("overload") or {}
+    for row_name, row in (overload_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "peak_streams" in row:
+            result[f"serve_cpu_overload_{row_name}_peak_streams"] = row[
+                "peak_streams"
+            ]
+    if "peak_streams_ratio" in overload_ab:
+        result["serve_cpu_overload_peak_streams_ratio"] = overload_ab[
+            "peak_streams_ratio"
+        ]
+    suspend_row = (overload_ab.get("rows") or {}).get("suspend") or {}
+    for key in ("suspends", "resumes", "streams_match_hold"):
+        if key in suspend_row:
+            result[f"serve_cpu_overload_{key}"] = suspend_row[key]
     try:
         with open(_AB_PATH) as fh:
             table = json.load(fh)

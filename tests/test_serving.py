@@ -388,6 +388,22 @@ class FakePagedEngine:
                 emitted[s] = total % 97
         return pool, emitted, rngs
 
+    def extract_blocks(self, params, pool, block_ids, block_size):
+        self.calls.append(
+            ("extract", tuple(int(b) for b in np.asarray(block_ids)))
+        )
+        return np.asarray(pool)[np.asarray(block_ids)].copy()
+
+    def inject_blocks(self, params, pool, block_ids, payload, block_size):
+        self.calls.append(
+            ("inject", tuple(int(b) for b in np.asarray(block_ids)))
+        )
+        pool = np.array(pool)
+        payload = np.asarray(payload)
+        for j, block in enumerate(np.asarray(block_ids)):
+            pool[block] = payload[j]
+        return pool
+
 
 def _paged_scheduler(max_slots=2, num_blocks=None, **kwargs):
     engine = FakePagedEngine()
@@ -590,6 +606,12 @@ def _legacy_stream(model, params, prompt, max_new, eos=None):
     return row
 
 
+@pytest.mark.slow  # tier-1 budget: the dense HTTP e2e is represented by
+# test_run_serving_task_body_advertises_and_serves (dense stack through
+# the real frontend) + the engine-level legacy parity in
+# test_whole_prompt_replay_matches_legacy; the HTTP-streams-match-legacy
+# bar stays in tier-1 via test_kv_oversubscription.py::
+# test_http_suspend_resume_stream_matches_legacy_fp_greedy.
 def test_http_end_to_end_matches_legacy_with_slot_reuse():
     """The acceptance bar: 3 concurrent requests with different prompt
     and output lengths through the real HTTP frontend produce token

@@ -684,6 +684,16 @@ def default_entries() -> List[HloEntry]:
             "models.decode_engine.paged_prefill",
             Manifest(collectives={}, donate_argnums=(2,)),
         ),
+        # The KV-oversubscription swap programs: extract is a read-only
+        # gather (NOT donated — the pool must survive the suspend),
+        # inject donates the pool so resume splices in place. Zero
+        # collectives: swap traffic is the scheduler's one planned bulk
+        # device_get/put, never a cross-device exchange.
+        _entry("models.decode_engine.extract_blocks"),
+        _entry(
+            "models.decode_engine.inject_blocks",
+            Manifest(collectives={}, donate_argnums=(0,)),
+        ),
         _entry(
             "models.decode_engine.spec_step",
             Manifest(collectives={}, donate_argnums=(1, 5)),
@@ -822,6 +832,63 @@ def _decode_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
     return drive
 
 
+def _swap_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
+    def drive():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from flax import linen as nn
+
+        from tf_yarn_tpu.models.decode_engine import DecodeEngine
+        from tf_yarn_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_yarn_tpu.serving.paging import TRASH_BLOCK
+
+        config = TransformerConfig.tiny(
+            max_seq_len=32, scan_layers=False, remat=False
+        )
+        model = Transformer(config)
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+        )
+        engine = DecodeEngine(
+            model, batch_buckets=(2,), prompt_buckets=(8,)
+        )
+        slots, block_size = 2, 8
+        pool = engine.make_paged_pool(params, 5, block_size)
+        rngs = jnp.stack(
+            [jax.random.PRNGKey(i) for i in range(slots)]
+        )
+        mask = jnp.ones((slots,), jnp.bool_)
+        max_blocks = config.max_seq_len // block_size
+        for tick in range(3):
+            # One suspend/resume round per tick, interleaved with the
+            # decode tick. Block ids, fill counts, tokens, lengths all
+            # vary — every one is traced data, never a compile key.
+            tokens = jnp.full((slots,), tick + 3, jnp.int32)
+            tables = jnp.full(
+                (slots, max_blocks), (tick % 3) + 1, jnp.int32
+            )
+            lengths = jnp.full((slots,), tick + 1, jnp.int32)
+            pool, _emitted, rngs = engine.paged_step(
+                params, pool, tables, lengths, tokens, rngs, mask,
+                block_size=block_size,
+            )
+            ids = np.full((max_blocks,), TRASH_BLOCK, np.int32)
+            ids[: tick + 1] = np.arange(1, tick + 2, dtype=np.int32)
+            payload = jax.device_get(
+                engine.extract_blocks(params, pool, ids, block_size)
+            )
+            pool = engine.inject_blocks(
+                params, pool, ids, payload, block_size
+            )
+        return engine.program_keys()
+
+    return drive
+
+
 def _rank_churn_driver() -> Callable[[], Dict[str, List[tuple]]]:
     def drive():
         import jax
@@ -865,6 +932,16 @@ def default_churn_entries() -> List[ChurnEntry]:
             # spec_step covers the chunk-apply: n_known sweeps the whole
             # decode-to-replay range without minting a second program.
             expected={"step": 1, "paged_step": 1, "spec_step": 1},
+        ),
+        ChurnEntry(
+            "models.decode_engine.swap_churn",
+            _swap_churn_driver,
+            # Three suspend/resume rounds interleaved with decode ticks:
+            # block ids, fill counts, and lengths all vary, yet swap
+            # mints exactly ONE extract and ONE inject program (fixed
+            # table width; ids are traced) and the decode tick itself
+            # never recompiles across the churn.
+            expected={"paged_step": 1, "extract": 1, "inject": 1},
         ),
         ChurnEntry(
             "models.rank_engine.rank_churn",

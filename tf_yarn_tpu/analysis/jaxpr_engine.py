@@ -677,6 +677,47 @@ def _decode_entries() -> List[EntryPoint]:
         )
         return prefill_and_pack, args, {}
 
+    def extract_blocks():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            _decode_cache_aval,
+            build_extract_blocks_fn,
+        )
+
+        model, params, pool, _tables, _lengths, _slots = _paged_avals()
+        row = _decode_cache_aval(model, params)
+        max_blocks = model.config.max_seq_len // 8
+        fn = build_extract_blocks_fn(model, row)
+        args = (
+            pool,
+            jax.ShapeDtypeStruct((max_blocks,), jnp.int32),  # block ids
+        )
+        return fn, args, {}
+
+    def inject_blocks():
+        import jax
+        import jax.numpy as jnp
+
+        from tf_yarn_tpu.models.decode_engine import (
+            _decode_cache_aval,
+            build_extract_blocks_fn,
+            build_inject_blocks_fn,
+        )
+
+        model, params, pool, _tables, _lengths, _slots = _paged_avals()
+        row = _decode_cache_aval(model, params)
+        max_blocks = model.config.max_seq_len // 8
+        ids = jax.ShapeDtypeStruct((max_blocks,), jnp.int32)
+        # The payload pytree is whatever extract produces for this pool
+        # layout — swap-in replays swap-out's shapes exactly.
+        payload = jax.eval_shape(
+            build_extract_blocks_fn(model, row), pool, ids
+        )
+        fn = build_inject_blocks_fn(model, row)
+        return fn, (pool, ids, payload), {}
+
     def _tp_sharded(paged: bool):
         """The TENSOR-PARALLEL serving tick, lowered exactly as the
         engine lowers it: params placed by the logical-axis rules, the
@@ -884,6 +925,16 @@ def _decode_entries() -> List[EntryPoint]:
         EntryPoint("models.decode_engine.paged_step", paged_step),
         # Paged admission's device work: bucketed prefill + block splice.
         EntryPoint("models.decode_engine.paged_prefill", paged_prefill),
+        # The KV-oversubscription swap programs: extract gathers a
+        # suspended slot's pool rows for the bulk device_get (read-only
+        # — the one PLANNED host transfer lives in the scheduler, not
+        # the program), inject scatters them back on resume (pool
+        # donated). Both take traced block ids at the fixed table
+        # width, so suspend/resume churn adds ZERO compile keys — and
+        # neither may smuggle in a host callback, or every swap becomes
+        # a per-leaf sync instead of one bulk copy.
+        EntryPoint("models.decode_engine.extract_blocks", extract_blocks),
+        EntryPoint("models.decode_engine.inject_blocks", inject_blocks),
         # The SPECULATIVE ticks: one windowed verify forward advances
         # every slot up to spec_k + 1 tokens. The accept/reject masking
         # must be entirely traced — a host callback here would sync the

@@ -110,6 +110,17 @@ class _FakePagedEngine:
                 emitted[slot] = total % 97
         return pool, emitted, rngs
 
+    def extract_blocks(self, params, pool, block_ids, block_size):
+        return np.asarray(pool)[np.asarray(block_ids)].copy()
+
+    def inject_blocks(self, params, pool, block_ids, payload,
+                      block_size):
+        pool = np.array(pool)
+        payload = np.asarray(payload)
+        for j, block in enumerate(np.asarray(block_ids)):
+            pool[block] = payload[j]
+        return pool
+
 
 class _FakeRankEngine:
     """MicroBatchScheduler's engine contract with host state: score =
@@ -205,6 +216,58 @@ def _slot_scheduler(tracer: RaceTracer) -> None:
     _phase("race-submit-b", submit(1))
     _phase("race-tick-b", tick_until_done)
     _phase("race-stats-b", lambda: scheduler.stats())
+
+
+def _suspend_resume(tracer: RaceTracer) -> None:
+    """SlotScheduler with a host tier under KV oversubscription: a
+    batch-tier stream is suspended (blocks swapped to the host store)
+    to admit an interactive request, then resumed after it retires —
+    tiered submits, swap ticks and stats snapshots on distinct threads
+    cover the suspend/resume lifecycle's lock discipline."""
+    from tf_yarn_tpu.serving.request import SamplingParams
+    from tf_yarn_tpu.serving.scheduler import SlotScheduler
+
+    scheduler = SlotScheduler(
+        _FakePagedEngine(), params=None, max_slots=2,
+        kv_layout="paged", block_size=4, max_seq_len=32,
+        num_blocks=5, kv_host_blocks=16,
+        tier_caps={"batch": 2, "interactive": 2},
+    )
+    tracer.watch(scheduler, "scheduler")
+    tracer.watch(scheduler.queue, "queue")
+    tracer.watch(scheduler._blocks, "pool")
+    tracer.watch(scheduler._prefix, "prefix")
+    tracer.watch(scheduler._host_store, "host_store")
+
+    responses: list = []
+
+    def submit(prompt, tier):
+        def body():
+            responses.append(scheduler.submit(
+                list(prompt), SamplingParams(max_new_tokens=6), tier=tier,
+            ))
+        return body
+
+    def tick(count):
+        def body():
+            for _ in range(count):
+                scheduler.tick()
+        return body
+
+    def tick_until_done():
+        for _ in range(200):
+            scheduler.tick()
+            if all(response.done for response in responses):
+                return
+        raise RuntimeError("oversubscribed scheduler not drained")
+
+    _phase("race-submit-batch", submit(range(1, 9), "batch"))
+    _phase("race-tick-batch", tick(3))
+    _phase("race-submit-interactive", submit(range(2, 10), "interactive"))
+    _phase("race-tick-swap", tick_until_done)
+    _phase("race-stats", lambda: scheduler.stats())
+    if not scheduler.stats()["swap"]["suspends"]:
+        raise RuntimeError("scenario never exercised a suspend")
 
 
 def _micro_batch(tracer: RaceTracer) -> None:
@@ -367,6 +430,23 @@ def default_scenarios() -> List[Scenario]:
                 ("scheduler._ticks", _ADVISORY),
                 ("scheduler._prefill_tokens", _ADVISORY),
                 ("scheduler._decode_tokens", _ADVISORY),
+                ("scheduler._peak_streams", _ADVISORY),
+                ("prefix.hits", _ADVISORY),
+                ("prefix.misses", _ADVISORY),
+            ),
+        ),
+        Scenario(
+            "serving.suspend_resume", _suspend_resume,
+            allow=(
+                ("scheduler._ticks", _ADVISORY),
+                ("scheduler._prefill_tokens", _ADVISORY),
+                ("scheduler._decode_tokens", _ADVISORY),
+                ("scheduler._peak_streams", _ADVISORY),
+                ("scheduler._suspends", _ADVISORY),
+                ("scheduler._resumes", _ADVISORY),
+                ("scheduler._swap_out_blocks", _ADVISORY),
+                ("scheduler._swap_in_blocks", _ADVISORY),
+                ("host_store._used", _ADVISORY),
                 ("prefix.hits", _ADVISORY),
                 ("prefix.misses", _ADVISORY),
             ),

@@ -354,6 +354,17 @@ class ServingExperiment:
     # always advance).
     prefill_chunk: Any = 0
     prefill_budget_per_tick: Optional[int] = None
+    # KV oversubscription (docs/Serving.md "KV oversubscription & SLO
+    # tiers"): ``kv_host_blocks`` > 0 backs the paged pool with that
+    # many host-RAM blocks — under pool pressure the scheduler swaps
+    # the lowest-SLO-tier active stream out to the host tier (bit-
+    # identical on resume) instead of holding admissions; 2x the
+    # device pool is the ROADMAP sizing. ``tier_caps`` maps tier name
+    # ("interactive"/"standard"/"batch") -> max in-system requests for
+    # that tier (queued + active + suspended); a tier at its cap
+    # answers 429.
+    kv_host_blocks: int = 0
+    tier_caps: Optional[Dict[str, int]] = None
     # Tensor-parallel decode (docs/Serving.md "Tensor-parallel decode"):
     # MeshSpec(tp=N) shards this replica's weights and slot KV across N
     # devices. None (default) = single-device decode, exactly as before.
@@ -438,6 +449,25 @@ class ServingExperiment:
                     "prefill_budget_per_tick must be >= 1 or None, got "
                     f"{self.prefill_budget_per_tick}"
                 )
+        if self.kv_host_blocks < 0:
+            raise ValueError(
+                f"kv_host_blocks must be >= 0, got {self.kv_host_blocks}"
+            )
+        if self.kv_host_blocks and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_host_blocks (the host swap tier) requires "
+                "kv_layout='paged'"
+            )
+        if self.tier_caps is not None:
+            from tf_yarn_tpu.serving.request import tier_rank
+
+            for name, cap in self.tier_caps.items():
+                tier_rank(name)  # ValueError on an unknown tier name
+                if not isinstance(cap, int) or cap < 0:
+                    raise ValueError(
+                        f"tier_caps[{name!r}] must be an int >= 0, "
+                        f"got {cap!r}"
+                    )
         if self.mesh_spec is not None:
             # Reject bad TP configs HERE — before any restore/trace —
             # with errors that name the knob, not the XLA partitioner's

@@ -741,6 +741,69 @@ def build_pack_prefill_fn(model, block_size: int, prefill_len: int):
     return pack
 
 
+def build_extract_blocks_fn(model, row_aval):
+    """The swap-out gather program: read W pool blocks in one bulk op.
+
+        fn(pool, block_ids) -> payload
+
+    `block_ids` is a traced (W,) int32 vector (W static from its
+    shape), so ONE compiled program serves every suspend regardless of
+    which physical blocks a slot holds — the scheduler pads short id
+    vectors with the trash block and discards those rows host-side.
+    The payload pytree mirrors the pool (index leaves stay None) with
+    the block axis narrowed to W, in the pool's own dtype — an int8
+    pool swaps as quantized bytes. Pure gather: no host callbacks
+    (TYA103), so the only host hop is the caller's `device_get`.
+    """
+    max_seq_len = model.config.max_seq_len
+
+    def extract(pool, block_ids):
+        def leaf(pool_leaf, aval):
+            if pool_leaf is None:
+                return None
+            ax = _seq_axis(aval.shape, max_seq_len)
+            return jnp.take(pool_leaf, block_ids, axis=ax)
+
+        return jax.tree_util.tree_map(leaf, pool, row_aval,
+                                      is_leaf=_is_none)
+
+    return extract
+
+
+def build_inject_blocks_fn(model, row_aval):
+    """The swap-in scatter program, inverse of `build_extract_blocks_fn`:
+
+        fn(pool, block_ids, payload) -> pool
+
+    Writes payload row j into physical block `block_ids[j]` (traced
+    values, static width) via the same dynamic_update_slice splice as
+    `build_pack_prefill_fn`. The pool is donated by the engine wrapper
+    so resume updates HBM in place. Rows the scheduler does not want
+    re-injected (prefix-cache hits re-attached by lookup, padding) are
+    aimed at the trash block, whose content is garbage by contract.
+    """
+    max_seq_len = model.config.max_seq_len
+
+    def inject(pool, block_ids, payload):
+        def leaf(pool_leaf, aval, pay_leaf):
+            if pool_leaf is None:
+                return None
+            ax = _seq_axis(aval.shape, max_seq_len)
+            for j in range(block_ids.shape[0]):
+                chunk = jax.lax.slice_in_dim(pay_leaf, j, j + 1, axis=ax)
+                starts = [jnp.asarray(0, jnp.int32)] * pool_leaf.ndim
+                starts[ax] = block_ids[j]
+                pool_leaf = jax.lax.dynamic_update_slice(
+                    pool_leaf, chunk.astype(pool_leaf.dtype), tuple(starts)
+                )
+            return pool_leaf
+
+        return jax.tree_util.tree_map(leaf, pool, row_aval, payload,
+                                      is_leaf=_is_none)
+
+    return inject
+
+
 def cache_nbytes(tree) -> int:
     """Resident bytes of a cache pytree (dense slot grid or paged pool;
     None leaves — elided index leaves — count zero). GLOBAL bytes: a
@@ -946,6 +1009,10 @@ class DecodeEngine:
             "spec_step_cache_hits": 0,
             "paged_spec_step_compiles": 0,
             "paged_spec_step_cache_hits": 0,
+            "extract_compiles": 0,
+            "extract_cache_hits": 0,
+            "inject_compiles": 0,
+            "inject_cache_hits": 0,
             "unbucketed_shapes": 0,
             "oversize_batch_chunks": 0,
         }
@@ -953,6 +1020,8 @@ class DecodeEngine:
         self._pack: Dict[tuple, Any] = {}
         self._spec_step: Dict[tuple, Any] = {}
         self._paged_spec_step: Dict[tuple, Any] = {}
+        self._extract: Dict[tuple, Any] = {}
+        self._inject: Dict[tuple, Any] = {}
 
         # Slot-grid splice helpers (continuous batching): donated, so the
         # grid updates HBM in place instead of copying the whole KV store
@@ -1408,6 +1477,64 @@ class DecodeEngine:
                             prefill=prefill_len):
             return compiled(*args)
 
+    def extract_blocks(self, params, pool, block_ids, block_size: int):
+        """Gather `block_ids` (traced (W,) values — W fixed at the
+        block-table width keeps this at ONE compile key per pool
+        layout) pool rows into a dense payload pytree for a bulk
+        `jax.device_get`. Read-only: the pool is NOT donated. Padding
+        ids should aim at the trash block; their payload rows are
+        garbage the caller discards."""
+        params = self._place_params(params)
+        block_ids = jnp.asarray(block_ids, jnp.int32)
+        width = int(block_ids.shape[0])
+        key = ("extract", width, block_size, self._tree_fingerprint(pool))
+        args = (pool, block_ids)
+
+        def _build():
+            # The row aval costs a whole-model eval_shape trace — only
+            # pay it on the compile miss, never on the per-swap hit
+            # path (a suspend must cost one gather, not one trace).
+            row_aval = _decode_cache_aval(self.model, params)
+            fn = build_extract_blocks_fn(self.model, row_aval)
+            return self._jit(fn, args).lower(*args).compile()
+
+        compiled = self._compiled(self._extract, key, "extract", _build)
+        with telemetry.span("decode_engine/extract_blocks", blocks=width):
+            return compiled(*args)
+
+    def inject_blocks(self, params, pool, block_ids, payload,
+                      block_size: int):
+        """Scatter a swap payload (same pytree `extract_blocks`
+        produced, host or device arrays) back into physical blocks
+        `block_ids`. The pool is donated — HBM updates in place; use
+        the return. Rows that must not land (prefix-cache hits, pad)
+        are aimed at the trash block."""
+        params = self._place_params(params)
+        block_ids = jnp.asarray(block_ids, jnp.int32)
+        width = int(block_ids.shape[0])
+        key = ("inject", width, block_size, self._tree_fingerprint(pool))
+        payload = jax.tree_util.tree_map(
+            lambda leaf: None if leaf is None else jnp.asarray(leaf),
+            payload, is_leaf=_is_none,
+        )
+        args = (pool, block_ids, payload)
+
+        def _build():
+            # Same hit-path discipline as extract_blocks: the model
+            # trace behind the row aval runs once per layout, not once
+            # per resume.
+            row_aval = _decode_cache_aval(self.model, params)
+            fn = build_inject_blocks_fn(self.model, row_aval)
+            out_shardings = self._shardings_of(pool) \
+                if self.mesh is not None else None
+            return self._jit(
+                fn, args, donate=(0,), out_shardings=out_shardings,
+            ).lower(*args).compile()
+
+        compiled = self._compiled(self._inject, key, "inject", _build)
+        with telemetry.span("decode_engine/inject_blocks", blocks=width):
+            return compiled(*args)
+
     def paged_step(
         self,
         params,
@@ -1549,6 +1676,8 @@ class DecodeEngine:
             "pack": self._pack,
             "spec_step": self._spec_step,
             "paged_spec_step": self._paged_spec_step,
+            "extract": self._extract,
+            "inject": self._inject,
         }
 
     def program_keys(self) -> Dict[str, List[tuple]]:

@@ -12,7 +12,12 @@ online server (docs/Serving.md):
   (``kv_layout="paged"``) with int8-transparent storage and a shared
   prompt-prefix cache.
 * :mod:`~tf_yarn_tpu.serving.paging` — host-side block-pool free list /
-  refcounts and the prefix-cache LRU behind the paged layout.
+  refcounts, the prefix-cache LRU, and the :class:`HostBlockStore`
+  host-RAM tier behind the paged layout. With ``kv_host_blocks`` > 0
+  the scheduler oversubscribes the device pool: under pressure the
+  lowest-SLO-tier active stream swaps its KV blocks out to host RAM
+  and resumes bit-identically when capacity frees ("KV
+  oversubscription & SLO tiers" in docs/Serving.md).
 
   The scheduler also carries the speculative path (``spec_k > 0``): a
   host-side self-drafter proposes tokens per slot, one compiled
@@ -32,18 +37,26 @@ Launch through :func:`tf_yarn_tpu.client.run_on_tpu` with a
 the coordination KV store for discovery.
 """
 
-from tf_yarn_tpu.serving.paging import BlockPool, PrefixCache  # noqa: F401
+from tf_yarn_tpu.serving.paging import (  # noqa: F401
+    BlockPool,
+    HostBlockStore,
+    PrefixCache,
+)
 from tf_yarn_tpu.serving.request import (  # noqa: F401
+    DEFAULT_TIER,
     FINISH_DEADLINE,
     FINISH_EOS,
     FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_SHUTDOWN,
+    TIERS,
     AdmissionQueue,
     QueueFull,
     Request,
     Response,
+    RetryAfterEstimator,
     SamplingParams,
+    tier_rank,
 )
 from tf_yarn_tpu.serving.scheduler import SlotScheduler  # noqa: F401
 from tf_yarn_tpu.serving.server import (  # noqa: F401
@@ -55,18 +68,23 @@ from tf_yarn_tpu.serving.server import (  # noqa: F401
 __all__ = [
     "AdmissionQueue",
     "BlockPool",
+    "DEFAULT_TIER",
     "FINISH_DEADLINE",
     "FINISH_EOS",
     "FINISH_ERROR",
     "FINISH_LENGTH",
     "FINISH_SHUTDOWN",
+    "HostBlockStore",
     "PrefixCache",
     "QueueFull",
     "Request",
     "Response",
+    "RetryAfterEstimator",
     "SamplingParams",
     "ServingServer",
     "SlotScheduler",
+    "TIERS",
     "advertised_endpoint",
     "run_serving",
+    "tier_rank",
 ]

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
-from typing import Deque, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 TRASH_BLOCK = 0  # physical block 0: write target for masked-off slots
 
@@ -212,6 +212,22 @@ class PrefixCache:
         key, ids = self._entries.popitem(last=False)  # LRU end
         return self.pool.release(ids)
 
+    def evictable_blocks(self) -> int:
+        """Blocks eviction could return to the pool RIGHT NOW: blocks
+        whose every reference is a cache entry's (no slot holds them,
+        nothing retained them). The resume path uses this to skip
+        evictions that cannot cover its deficit — dropping entries that
+        free nothing would only strip prefixes a later lookup could
+        share."""
+        membership: Dict[int, int] = {}
+        for ids in self._entries.values():
+            for block in ids:
+                membership[block] = membership.get(block, 0) + 1
+        return sum(
+            1 for block, count in membership.items()
+            if self.pool.refcount(block) == count
+        )
+
     def evict_for(self, n_blocks: int) -> int:
         """Release LRU entries until >= n_blocks are free in the pool
         (or the cache is empty). Returns blocks actually freed. Entries
@@ -228,3 +244,72 @@ class PrefixCache:
         while self._entries:
             freed += self._evict_one()
         return freed
+
+
+class HostBlockStore:
+    """Host-RAM tier under the device :class:`BlockPool`: capacity-
+    accounted parking for suspended slots' KV block payloads.
+
+    The store never touches jax — the scheduler hands it an already
+    device_get'd payload (whatever pytree `extract_blocks` produced,
+    int8 pools included, stored as-is) keyed by request id, and takes
+    it back verbatim on resume. Capacity is counted in *blocks* so the
+    `kv_host_blocks` knob composes with the device pool's `num_blocks`
+    (host bytes/block == device bytes/block for fp pools, 4x less for
+    int8 — the payload is whatever dtype the pool holds).
+    """
+
+    def __init__(self, capacity_blocks: int, block_size: int):
+        if capacity_blocks < 0:
+            raise ValueError(
+                f"capacity_blocks must be >= 0, got {capacity_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.capacity_blocks = int(capacity_blocks)
+        self.block_size = int(block_size)
+        self._entries: "collections.OrderedDict[object, Tuple[int, object]]" \
+            = collections.OrderedDict()
+        self._used = 0
+
+    @property
+    def used_blocks(self) -> int:
+        return self._used
+
+    @property
+    def free_blocks(self) -> int:
+        return self.capacity_blocks - self._used
+
+    @property
+    def entries(self) -> int:
+        return len(self._entries)
+
+    def can_hold(self, n_blocks: int) -> bool:
+        return n_blocks <= self.free_blocks
+
+    def put(self, key, n_blocks: int, payload) -> None:
+        """Park `payload` (opaque to the store) under `key`, charging
+        `n_blocks` against capacity. Raises if the key is already held
+        or capacity would be exceeded — the scheduler checks
+        `can_hold` first, so either is a bookkeeping bug."""
+        if key in self._entries:
+            raise ValueError(f"host store already holds key {key!r}")
+        if n_blocks < 0:
+            raise ValueError(f"cannot store {n_blocks} blocks")
+        if n_blocks > self.free_blocks:
+            raise ValueError(
+                f"host store over capacity: {n_blocks} blocks requested, "
+                f"{self.free_blocks} free of {self.capacity_blocks}"
+            )
+        self._entries[key] = (int(n_blocks), payload)
+        self._used += int(n_blocks)
+
+    def pop(self, key) -> Tuple[int, object]:
+        """Remove and return (n_blocks, payload) for `key`, releasing
+        its capacity charge."""
+        n_blocks, payload = self._entries.pop(key)
+        self._used -= n_blocks
+        return n_blocks, payload
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries

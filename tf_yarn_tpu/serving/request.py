@@ -16,13 +16,14 @@ producer-side hooks (`_push`/`_finish`).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import itertools
 import queue
 import threading
 import time
-from typing import Iterator, List, Optional, Tuple
+from typing import Deque, Iterator, List, Optional, Tuple
 
 # finish_reason values a Response can end with.
 FINISH_EOS = "eos"            # the model emitted the request's eos token
@@ -30,6 +31,26 @@ FINISH_LENGTH = "length"      # max_new_tokens generated
 FINISH_DEADLINE = "deadline"  # per-request deadline hit (queued or active)
 FINISH_SHUTDOWN = "shutdown"  # scheduler closed with the request in flight
 FINISH_ERROR = "error"        # a scheduler tick failed with it in flight
+
+# SLO tiers, lowest to highest. Admission order and suspend-victim
+# selection both key on the rank: `interactive` requests jump the queue
+# and are never parked while a lower tier runs; `batch` absorbs the
+# pool pressure (suspended to the host tier first, resumed last).
+TIERS = ("batch", "standard", "interactive")
+DEFAULT_TIER = "standard"
+_TIER_RANK = {name: rank for rank, name in enumerate(TIERS)}
+
+
+def tier_rank(tier: str) -> int:
+    """Numeric rank of an SLO tier name (higher = more latency-
+    sensitive). Raises ValueError on an unknown tier — the HTTP
+    frontend surfaces this as a 400."""
+    try:
+        return _TIER_RANK[tier]
+    except KeyError:
+        raise ValueError(
+            f"unknown tier {tier!r}; expected one of {TIERS}"
+        ) from None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +105,7 @@ class Request:
     params: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     priority: int = 0
     timeout_s: Optional[float] = None
+    tier: str = DEFAULT_TIER
     id: int = dataclasses.field(default_factory=lambda: next(_REQUEST_IDS))
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
 
@@ -95,6 +117,11 @@ class Request:
             raise ValueError(
                 f"timeout_s must be > 0, got {self.timeout_s}"
             )
+        tier_rank(self.tier)  # validate
+
+    @property
+    def tier_rank(self) -> int:
+        return _TIER_RANK[self.tier]
 
     @property
     def deadline(self) -> Optional[float]:
@@ -187,13 +214,64 @@ class Response:
         return [b - a for a, b in zip(times, times[1:])]
 
 
+class RetryAfterEstimator:
+    """Load-aware Retry-After: `floor_s + depth_ahead / retire_rate`.
+
+    The static `retry_after_s` hint lies under load — a full queue
+    drains at the service rate, not in one constant interval. This
+    tracker records retirement timestamps in a sliding window and turns
+    (queue position, recent throughput) into a wait estimate, clamped
+    to the static hint as a floor. Rate is counted across ALL tiers
+    (every retirement frees a slot any tier can win); the caller passes
+    the per-tier `depth_ahead` — queued requests ordered at-or-above
+    the rejected one. No retirements observed yet -> the floor, same
+    as the static behavior.
+    """
+
+    def __init__(self, floor_s: float = 1.0, window_s: float = 30.0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.floor_s = float(floor_s)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._events: Deque[Tuple[float, int]] = collections.deque()
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def record_retire(self, tier: str = DEFAULT_TIER,
+                      now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._events.append((now, tier_rank(tier)))
+            self._prune(now)
+
+    def retire_rate(self, now: Optional[float] = None) -> float:
+        """Retirements per second over the sliding window (all tiers)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._prune(now)
+            return len(self._events) / self.window_s
+
+    def estimate(self, depth_ahead: int,
+                 now: Optional[float] = None) -> float:
+        rate = self.retire_rate(now)
+        if rate <= 0.0 or depth_ahead <= 0:
+            return self.floor_s
+        return max(self.floor_s, depth_ahead / rate)
+
+
 class AdmissionQueue:
     """Bounded priority admission queue.
 
     `submit` raises :class:`QueueFull` at capacity — backpressure is the
     caller's signal to shed or retry, never silent buffering. Ordering
-    is (priority desc, arrival order); `retry_after_s` is a static hint
-    the frontend turns into an HTTP Retry-After header.
+    is (SLO tier desc, priority desc, arrival order) — `tier` settles
+    ties only through `priority` within a tier. `retry_after_s` is the
+    static floor of the Retry-After hint; with an `estimator` attached
+    the hint scales with queue depth over the recent retire rate.
     """
 
     # The Response built per admission. Subclass hook: the ranking
@@ -201,23 +279,49 @@ class AdmissionQueue:
     # reusing this class's bound/priority/backpressure behavior intact.
     response_cls = Response
 
-    def __init__(self, capacity: int = 64, retry_after_s: float = 1.0):
+    def __init__(self, capacity: int = 64, retry_after_s: float = 1.0,
+                 estimator: Optional[RetryAfterEstimator] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self.retry_after_s = retry_after_s
+        self.estimator = estimator
         self._lock = threading.Lock()
-        self._heap: List[Tuple[int, int, Request, Response]] = []
+        self._heap: List[Tuple[int, int, int, Request, Response]] = []
         self._seq = itertools.count()
+
+    @staticmethod
+    def _rank_of(request: Request) -> int:
+        # getattr: the ranking subsystem submits its own Request type
+        # (no tier field) through the subclassed queue — it rides the
+        # default tier.
+        return getattr(request, "tier_rank", _TIER_RANK[DEFAULT_TIER])
+
+    def retry_hint(self, request: Request) -> float:
+        """The Retry-After to attach to a 429 for `request`: the load-
+        aware estimate when an estimator is attached, the static hint
+        otherwise."""
+        if self.estimator is None:
+            return self.retry_after_s
+        return self.estimator.estimate(self.depth_ahead(
+            self._rank_of(request)))
 
     def submit(self, request: Request) -> Response:
         response = self.response_cls(request)
         with self._lock:
             if len(self._heap) >= self.capacity:
-                raise QueueFull(len(self._heap), self.retry_after_s)
+                depth = len(self._heap)
+                hint = self.retry_after_s
+                if self.estimator is not None:
+                    rank = self._rank_of(request)
+                    ahead = sum(1 for entry in self._heap
+                                if -entry[0] >= rank)
+                    hint = self.estimator.estimate(ahead)
+                raise QueueFull(depth, hint)
             heapq.heappush(
                 self._heap,
-                (-request.priority, next(self._seq), request, response),
+                (-self._rank_of(request), -request.priority,
+                 next(self._seq), request, response),
             )
         return response
 
@@ -225,12 +329,18 @@ class AdmissionQueue:
         with self._lock:
             if not self._heap:
                 return None
-            _, _, request, response = heapq.heappop(self._heap)
+            _, _, _, request, response = heapq.heappop(self._heap)
             return request, response
+
+    def peek_rank(self) -> Optional[int]:
+        """Tier rank of the request `pop` would return next, or None on
+        an empty queue — the scheduler's resume-vs-admit arbiter."""
+        with self._lock:
+            return -self._heap[0][0] if self._heap else None
 
     def drain(self) -> List[Tuple[Request, Response]]:
         with self._lock:
-            items = [(req, resp) for _, _, req, resp in self._heap]
+            items = [(req, resp) for _, _, _, req, resp in self._heap]
             self._heap.clear()
             return items
 
@@ -238,3 +348,9 @@ class AdmissionQueue:
     def depth(self) -> int:
         with self._lock:
             return len(self._heap)
+
+    def depth_ahead(self, rank: int) -> int:
+        """Queued requests ordered at-or-above tier `rank` — the queue
+        position a new request of that tier would take."""
+        with self._lock:
+            return sum(1 for entry in self._heap if -entry[0] >= rank)

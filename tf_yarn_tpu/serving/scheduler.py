@@ -40,8 +40,16 @@ KV layouts (``kv_layout=``):
   reserves every block a request can ever need (prompt + max_new - 1
   tokens) up front, so decode never stalls mid-request; when the pool
   cannot cover the next request, admission *holds* it (LRU-evicting
-  prefix entries first) until retirements free blocks. The fp paged
-  path is BIT-IDENTICAL to the dense path and `generate_legacy`.
+  prefix entries first) until retirements free blocks — or, with a
+  host tier configured (``kv_host_blocks`` > 0), **suspends** the
+  lowest-SLO-tier active stream instead: its KV blocks bulk-gather
+  through the engine's `extract_blocks` program, `device_get` to a
+  :class:`HostBlockStore`, and scatter back through `inject_blocks`
+  when retirements free capacity (FIFO within tier) — the resumed
+  stream is BIT-IDENTICAL to an uninterrupted run (replay consumes no
+  RNG; the slot's rng row is saved/restored; prefix-shared blocks are
+  never swapped, they re-attach through the normal lookup). The fp
+  paged path is BIT-IDENTICAL to the dense path and `generate_legacy`.
 
 The scheduler is a pure host-side state machine: its only device
 contract is the engine's slot methods, so the unit tests drive it with
@@ -60,17 +68,26 @@ import numpy as np
 
 from tf_yarn_tpu import telemetry
 from tf_yarn_tpu.models.spec import make_drafter, plan_window
-from tf_yarn_tpu.serving.paging import BlockPool, PrefixCache
+from tf_yarn_tpu.serving.paging import (
+    TRASH_BLOCK,
+    BlockPool,
+    HostBlockStore,
+    PrefixCache,
+)
 from tf_yarn_tpu.serving.request import (
+    DEFAULT_TIER,
     FINISH_DEADLINE,
     FINISH_EOS,
     FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_SHUTDOWN,
     AdmissionQueue,
+    QueueFull,
     Request,
     Response,
+    RetryAfterEstimator,
     SamplingParams,
+    tier_rank,
 )
 
 _logger = logging.getLogger(__name__)
@@ -125,6 +142,29 @@ class _Slot:
         self.last_emit_at: Optional[float] = None
 
 
+class _Suspended:
+    """A stream parked on the host tier: its _Slot state (pending
+    replay, emission counts, drafter context) plus everything a resume
+    must restore exactly — the slot's rng row (bit-identity: resume
+    must NOT re-derive it from the seed), the valid KV length, and how
+    many leading blocks the swap payload covers. The payload itself
+    lives in the HostBlockStore keyed by request id."""
+
+    __slots__ = ("state", "rng", "length", "n_valid", "suspended_at")
+
+    def __init__(self, state: _Slot, rng: np.ndarray, length: int,
+                 n_valid: int, suspended_at: float):
+        self.state = state
+        self.rng = rng
+        self.length = length
+        self.n_valid = n_valid
+        self.suspended_at = suspended_at
+
+    @property
+    def request(self) -> Request:
+        return self.state.request
+
+
 class SlotScheduler:
     """Continuous batching over a fixed slot grid (module docstring).
 
@@ -164,6 +204,16 @@ class SlotScheduler:
     same program untouched. Emitted streams stay BIT-IDENTICAL to the
     blocking path (replay consumes no RNG either way), and
     ``context_limit`` reserves ``window - 1`` positions of KV headroom.
+
+    KV oversubscription (docs/Serving.md "KV oversubscription & SLO
+    tiers"): ``kv_host_blocks`` > 0 (paged layout only) backs the
+    device pool with that many host-RAM blocks; under pool pressure
+    the scheduler SUSPENDS the lowest-tier active stream (swap out)
+    instead of holding the new admission, and resumes it — bit-
+    identically — once capacity frees. ``tier_caps`` maps tier name ->
+    max in-system requests (queued + active + suspended); a tier at
+    its cap rejects with QueueFull (HTTP 429), keeping batch floods
+    from ever crowding the interactive tier's queue.
     """
 
     def __init__(
@@ -188,6 +238,8 @@ class SlotScheduler:
         decode_attention: str = "gather",
         prefill_chunk=None,
         prefill_budget_per_tick: Optional[int] = None,
+        kv_host_blocks: int = 0,
+        tier_caps: Optional[Dict[str, int]] = None,
     ):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -277,7 +329,43 @@ class SlotScheduler:
         self._spec_accepted = 0
         self._prefill_tokens = 0
         self._decode_tokens = 0
-        self.queue = AdmissionQueue(queue_capacity, retry_after_s)
+        kv_host_blocks = int(kv_host_blocks or 0)
+        if kv_host_blocks < 0:
+            raise ValueError(
+                f"kv_host_blocks must be >= 0, got {kv_host_blocks}"
+            )
+        if kv_host_blocks and kv_layout != "paged":
+            raise ValueError(
+                "kv_host_blocks (the host swap tier) requires "
+                "kv_layout='paged' — dense slots have no block pool "
+                "to oversubscribe"
+            )
+        self.kv_host_blocks = kv_host_blocks
+        self.tier_caps: Dict[str, int] = {}
+        for name, cap in dict(tier_caps or {}).items():
+            tier_rank(name)  # unknown tier names fail loudly here
+            if int(cap) < 0:
+                raise ValueError(
+                    f"tier_caps[{name!r}] must be >= 0, got {cap}"
+                )
+            self.tier_caps[name] = int(cap)
+        # Load-aware backpressure: retirements feed the sliding-window
+        # rate, 429s carry depth_ahead / rate (floored at the static
+        # retry_after_s hint).
+        self._estimator = RetryAfterEstimator(floor_s=retry_after_s)
+        self.queue = AdmissionQueue(
+            queue_capacity, retry_after_s, estimator=self._estimator
+        )
+        self._tier_lock = threading.Lock()
+        self._tier_inflight: Dict[str, int] = {}
+        # Streams parked on the host tier, in suspension order; resume
+        # picks the highest tier first, FIFO within a tier.
+        self._suspended: List[_Suspended] = []
+        self._suspends = 0
+        self._resumes = 0
+        self._swap_out_blocks = 0
+        self._swap_in_blocks = 0
+        self._peak_streams = 0
         self._rngs = np.zeros((max_slots, 2), np.uint32)
         self._slots: List[Optional[_Slot]] = [None] * max_slots
         self._free: Deque[int] = collections.deque(range(max_slots))
@@ -325,6 +413,10 @@ class SlotScheduler:
             )
             self._blocks = BlockPool(num_blocks, self._block_size)
             self._prefix = PrefixCache(self._blocks, prefix_cache_capacity)
+            self._host_store = (
+                HostBlockStore(kv_host_blocks, self._block_size)
+                if kv_host_blocks else None
+            )
             self._tables = np.zeros(
                 (max_slots, self._blocks_per_slot), np.int32
             )
@@ -336,6 +428,7 @@ class SlotScheduler:
             self._block_size = None
             self._blocks = None
             self._prefix = None
+            self._host_store = None
             kv_bytes = _cache_nbytes(self._cache)
         self._kv_bytes = kv_bytes
         # Per-DEVICE residency: under tp sharding each device holds 1/tp
@@ -374,10 +467,12 @@ class SlotScheduler:
         params: Optional[SamplingParams] = None,
         priority: int = 0,
         timeout_s: Optional[float] = None,
+        tier: str = DEFAULT_TIER,
     ) -> Response:
         """Admit one request; returns its streaming Response. Raises
-        ValueError for requests this grid cannot serve and QueueFull when
-        the bounded queue is at capacity (backpressure)."""
+        ValueError for requests this grid cannot serve (an unknown
+        `tier` included) and QueueFull when the bounded queue — or the
+        request's tier cap — is at capacity (backpressure)."""
         params = params or SamplingParams(
             temperature=self.temperature, top_k=self.top_k, top_p=self.top_p
         )
@@ -393,7 +488,7 @@ class SlotScheduler:
             )
         request = Request(
             prompt=tuple(prompt), params=params, priority=priority,
-            timeout_s=timeout_s,
+            timeout_s=timeout_s, tier=tier,
         )
         limit = self.context_limit
         if limit is not None and (
@@ -419,7 +514,17 @@ class SlotScheduler:
                     "admitted; raise num_blocks or shorten the request"
                 )
         try:
-            response = self.queue.submit(request)
+            # Tier-cap + queue admission under one lock: the cap bounds
+            # the tier's whole in-system footprint (queued + active +
+            # suspended), so a batch flood 429s at its own cap instead
+            # of consuming queue capacity the interactive tier needs.
+            with self._tier_lock:
+                cap = self.tier_caps.get(request.tier)
+                inflight = self._tier_inflight.get(request.tier, 0)
+                if cap is not None and inflight >= cap:
+                    raise QueueFull(inflight, self.queue.retry_hint(request))
+                response = self.queue.submit(request)
+                self._tier_inflight[request.tier] = inflight + 1
         except Exception:
             self._registry.counter("serving/requests_rejected_total").inc()
             raise
@@ -446,6 +551,9 @@ class SlotScheduler:
         with telemetry.span("serving/tick") as tick_span:
             with telemetry.span("serving/retire"):
                 self._retire_deadlines(now, retired)
+            if self._suspended:
+                with telemetry.span("serving/resume"):
+                    self._resume_suspended(now, admitted)
             with telemetry.span("serving/admit"):
                 self._admit(now, admitted)
             active = [s for s in range(self.max_slots) if self._slots[s]]
@@ -457,6 +565,9 @@ class SlotScheduler:
                     else:
                         self._step(active, retired)
         worked = bool(active or admitted or retired)
+        streams = len([s for s in self._slots if s is not None]) \
+            + len(self._suspended)
+        self._peak_streams = max(self._peak_streams, streams)
         if worked:
             self._ticks += 1
             self._registry.histogram("serving/tick_seconds").observe(
@@ -496,6 +607,24 @@ class SlotScheduler:
             self._registry.gauge("serving/prefix_cache_hit_rate").set(
                 self._prefix.hit_rate
             )
+            if self._host_store is not None:
+                self._registry.gauge("serving/host_blocks_used").set(
+                    self._host_store.used_blocks
+                )
+                self._registry.gauge("serving/host_blocks_free").set(
+                    self._host_store.free_blocks
+                )
+                counts: Dict[str, int] = {}
+                for entry in self._suspended:
+                    tier = entry.request.tier
+                    counts[tier] = counts.get(tier, 0) + 1
+                for tier in self.tier_caps:
+                    counts.setdefault(tier, 0)
+                counts.setdefault(DEFAULT_TIER, 0)
+                for tier, count in counts.items():
+                    self._registry.gauge(
+                        "serving/suspended_streams", tier=tier
+                    ).set(count)
         return worked
 
     def _retire_deadlines(self, now: float, retired: List) -> None:
@@ -503,13 +632,42 @@ class SlotScheduler:
             state = self._slots[slot]
             if state is not None and state.request.expired(now):
                 self._retire(slot, FINISH_DEADLINE, retired)
+        for entry in [e for e in self._suspended
+                      if e.request.expired(now)]:
+            self._finish_suspended(entry, FINISH_DEADLINE, retired)
 
     def _finish_unadmitted(self, response: Response, reason: str) -> None:
         """A request that dies without ever occupying a slot."""
+        self._tier_dec(response.request)
         response._finish(reason)
         self._registry.counter(
             "serving/requests_completed_total", reason=reason
         ).inc()
+
+    def _tier_dec(self, request: Request) -> None:
+        tier = getattr(request, "tier", DEFAULT_TIER)
+        with self._tier_lock:
+            count = self._tier_inflight.get(tier, 0)
+            if count > 0:
+                self._tier_inflight[tier] = count - 1
+
+    def _finish_suspended(self, entry: _Suspended, reason: str,
+                          retired: List) -> None:
+        """A stream that dies while parked on the host tier: drop its
+        payload (freeing host capacity) and finish the response — it
+        holds no slot and no device blocks."""
+        self._suspended.remove(entry)
+        if entry.request.id in self._host_store:
+            self._host_store.pop(entry.request.id)
+        self._tier_dec(entry.request)
+        entry.state.response._finish(reason)
+        retired.append((entry.request.id, reason))
+        self._registry.counter(
+            "serving/requests_completed_total", reason=reason
+        ).inc()
+        self._registry.histogram("serving/request_seconds").observe(
+            time.monotonic() - entry.request.submitted_at
+        )
 
     def _admit(self, now: float, admitted: List[int]) -> None:
         while self._free:
@@ -525,10 +683,16 @@ class SlotScheduler:
                 self._finish_unadmitted(response, FINISH_DEADLINE)
                 continue
             if self.kv_layout == "paged":
-                if not self._admit_paged(request, response, now, admitted):
-                    # Pool exhausted: hold the request (FIFO head) until
-                    # retirements free blocks — admission order is
-                    # preserved, decode of in-flight requests continues.
+                ok = self._admit_paged(request, response, now, admitted)
+                # Pool exhausted: with a host tier, park lower-SLO-tier
+                # active streams (swap their blocks out) until this
+                # request fits or no eligible victim remains.
+                while not ok and self._suspend_victim_below(request):
+                    ok = self._admit_paged(request, response, now, admitted)
+                if not ok:
+                    # Hold the request (FIFO head) until retirements
+                    # free blocks — admission order is preserved,
+                    # decode of in-flight requests continues.
                     self._held = (request, response)
                     break
             else:
@@ -648,6 +812,183 @@ class SlotScheduler:
         state.registered_blocks = prefill_len // self._block_size
         self._slots[slot] = state
         self._record_admission(slot, request, now, admitted)
+        return True
+
+    # -- host-tier swap: suspend / resume ------------------------------------
+
+    def _suspend_victim_below(self, request: Request) -> bool:
+        """Park one active stream of a tier STRICTLY below `request`'s
+        to free its slot and blocks — lowest tier first, youngest
+        within a tier (the least sunk prefill work). Returns False when
+        no host tier is configured, no lower-tier stream is active, or
+        the host store cannot hold any candidate's valid blocks."""
+        if self._host_store is None:
+            return False
+        rank = request.tier_rank
+        candidates = [
+            slot for slot in range(self.max_slots)
+            if self._slots[slot] is not None
+            and self._slots[slot].request.tier_rank < rank
+        ]
+        candidates.sort(key=lambda slot: (
+            self._slots[slot].request.tier_rank,
+            -self._slots[slot].request.submitted_at,
+        ))
+        bs = self._block_size
+        for slot in candidates:
+            n_valid = -(-int(self._lengths[slot]) // bs)
+            if self._host_store.can_hold(n_valid):
+                self._suspend_slot(slot)
+                return True
+        return False
+
+    def _suspend_slot(self, slot: int) -> None:
+        """Swap one active slot out to the host tier: bulk-gather its
+        valid blocks (`extract_blocks` + one `device_get`), release ALL
+        its block references — private blocks return to the free list,
+        prefix-shared blocks survive on the cache's own reference and
+        re-attach on resume through the normal lookup — and free the
+        slot. The rng row is saved verbatim: bit-identity of the
+        resumed stream depends on it."""
+        state = self._slots[slot]
+        length = int(self._lengths[slot])
+        n_valid = -(-length // self._block_size)
+        started = time.monotonic()
+        payload = None
+        if n_valid:
+            ids = np.full((self._blocks_per_slot,), TRASH_BLOCK, np.int32)
+            ids[:n_valid] = state.blocks[:n_valid]
+            payload = _to_host(self.engine.extract_blocks(
+                self.params, self._pool, ids, self._block_size
+            ))
+        self._host_store.put(state.request.id, n_valid, payload)
+        self._blocks.release(state.blocks)
+        state.blocks = None
+        self._slots[slot] = None
+        self._free.append(slot)
+        self._tables[slot, :] = 0
+        self._lengths[slot] = 0
+        self._suspended.append(_Suspended(
+            state, self._rngs[slot].copy(), length, n_valid, started
+        ))
+        self._suspends += 1
+        self._swap_out_blocks += n_valid
+        tier = state.request.tier
+        self._registry.counter("serving/suspends_total", tier=tier).inc()
+        if n_valid:
+            self._registry.counter("serving/swap_out_blocks_total").inc(
+                n_valid
+            )
+            self._registry.histogram("serving/swap_seconds").observe(
+                time.monotonic() - started
+            )
+
+    def _pending_rank(self) -> Optional[int]:
+        """Highest tier rank waiting to be admitted (held or queued),
+        or None — the bar a resume must meet so parked streams never
+        jump a higher-tier admission (which would only re-suspend them:
+        swap thrash)."""
+        ranks = []
+        if self._held is not None:
+            ranks.append(self._held[0].tier_rank)
+        queued = self.queue.peek_rank()
+        if queued is not None:
+            ranks.append(queued)
+        return max(ranks) if ranks else None
+
+    def _resume_suspended(self, now: float, admitted: List[int]) -> None:
+        """Bring parked streams back while free slots and blocks allow:
+        highest tier first, FIFO within a tier (the first suspended is
+        the first back)."""
+        while self._free and self._suspended:
+            best = None
+            for entry in self._suspended:
+                if best is None or \
+                        entry.request.tier_rank > best.request.tier_rank:
+                    best = entry
+            barrier = self._pending_rank()
+            if barrier is not None and best.request.tier_rank < barrier:
+                return
+            if not self._try_resume(best, now, admitted):
+                return
+
+    def _try_resume(self, entry: _Suspended, now: float,
+                    admitted: List[int]) -> bool:
+        """Re-reserve the stream's full block budget, scatter its swap
+        payload back (`inject_blocks`), and reinstall the slot exactly
+        as suspended — saved length, saved rng row, pending replay
+        untouched. Shared prefix blocks re-attach through the normal
+        lookup, CAPPED at the saved length: a longer cached prefix
+        would park shared blocks at positions this slot will write,
+        violating the no-copy-on-write sharing invariant. Returns False
+        (stream stays parked) when the pool cannot cover it yet."""
+        request = entry.request
+        state = entry.state
+        prompt = request.prompt
+        n_total = self._blocks_needed(request)
+        _hit_tokens, hit_ids = self._prefix.lookup(
+            prompt, min(len(prompt) - 1, entry.length)
+        )
+        if hit_ids:
+            self._blocks.retain(hit_ids)
+        need = n_total - len(hit_ids)
+        if need > self._blocks.free_blocks:
+            # A parked stream retries every tick. Unlike admission,
+            # evict ONLY when eviction can actually cover the deficit:
+            # dropping entries whose blocks are slot-held frees nothing
+            # and would strip the shared prefix this very resume (or a
+            # later admission) could ride.
+            deficit_coverable = need <= (
+                self._blocks.free_blocks + self._prefix.evictable_blocks()
+            )
+            if not deficit_coverable:
+                if hit_ids:
+                    self._blocks.release(hit_ids)
+                return False
+            self._prefix.evict_for(need)
+        owned = self._blocks.allocate(need)
+        if owned is None:
+            if hit_ids:
+                self._blocks.release(hit_ids)
+            return False
+        blocks = hit_ids + owned
+        slot = self._free.popleft()
+        started = time.monotonic()
+        n_valid, payload = self._host_store.pop(request.id)
+        k_hit = len(hit_ids)
+        inject_n = max(0, n_valid - k_hit)
+        if inject_n:
+            # Rows [k_hit, n_valid) land in their new physical blocks;
+            # prefix-hit rows (already resident, shared) and the pad
+            # tail aim at the trash block.
+            ids = np.full((self._blocks_per_slot,), TRASH_BLOCK, np.int32)
+            for j in range(k_hit, n_valid):
+                ids[j] = blocks[j]
+            self._pool = self.engine.inject_blocks(
+                self.params, self._pool, ids, payload, self._block_size
+            )
+        self._suspended.remove(entry)
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        self._lengths[slot] = entry.length
+        self._rngs[slot] = entry.rng
+        state.blocks = blocks
+        self._slots[slot] = state
+        if self._used_before[slot]:
+            self._registry.counter("serving/slot_reuse_total").inc()
+        self._used_before[slot] = True
+        admitted.append(request.id)
+        self._resumes += 1
+        self._swap_in_blocks += inject_n
+        tier = request.tier
+        self._registry.counter("serving/resumes_total", tier=tier).inc()
+        if inject_n:
+            self._registry.counter("serving/swap_in_blocks_total").inc(
+                inject_n
+            )
+            self._registry.histogram("serving/swap_seconds").observe(
+                time.monotonic() - started
+            )
         return True
 
     def _step(self, active: List[int], retired: List) -> None:
@@ -905,6 +1246,10 @@ class SlotScheduler:
             self._blocks.release(state.blocks)
             self._tables[slot, :] = 0
             self._lengths[slot] = 0
+        self._tier_dec(state.request)
+        self._estimator.record_retire(
+            getattr(state.request, "tier", DEFAULT_TIER)
+        )
         state.response._finish(reason)
         retired.append((state.request.id, reason))
         self._registry.counter(
@@ -952,6 +1297,8 @@ class SlotScheduler:
         for _request, response in self.queue.drain():
             self._finish_unadmitted(response, reason)
         retired: List = []
+        for entry in list(self._suspended):
+            self._finish_suspended(entry, reason, retired)
         for slot in range(self.max_slots):
             if self._slots[slot] is not None:
                 self._retire(slot, reason, retired)
@@ -1011,6 +1358,17 @@ class SlotScheduler:
             "prefill_budget_per_tick": self.prefill_budget_per_tick,
             "prefill_tokens": self._prefill_tokens,
             "decode_tokens": self._decode_tokens,
+            "peak_streams": self._peak_streams,
+            "retire_rate_per_s": round(self._estimator.retire_rate(), 4),
+        }
+        with self._tier_lock:
+            tier_inflight = {
+                tier: count for tier, count in self._tier_inflight.items()
+                if count
+            }
+        snap["tiers"] = {
+            "inflight": tier_inflight,
+            "caps": dict(self.tier_caps),
         }
         if self._windowed:
             snap["spec"] = {
@@ -1034,6 +1392,25 @@ class SlotScheduler:
                 "misses": self._prefix.misses,
                 "hit_rate": round(self._prefix.hit_rate, 4),
             }
+            if self._host_store is not None:
+                suspended_by_tier: Dict[str, int] = {}
+                for entry in self._suspended:
+                    tier = entry.request.tier
+                    suspended_by_tier[tier] = \
+                        suspended_by_tier.get(tier, 0) + 1
+                snap["host_block_store"] = {
+                    "capacity_blocks": self._host_store.capacity_blocks,
+                    "used_blocks": self._host_store.used_blocks,
+                    "free_blocks": self._host_store.free_blocks,
+                    "entries": self._host_store.entries,
+                }
+                snap["suspended_streams"] = suspended_by_tier
+                snap["swap"] = {
+                    "suspends": self._suspends,
+                    "resumes": self._resumes,
+                    "swap_out_blocks": self._swap_out_blocks,
+                    "swap_in_blocks": self._swap_in_blocks,
+                }
         engine_stats = getattr(self.engine, "stats", None)
         if isinstance(engine_stats, dict):
             snap["decode_engine"] = dict(engine_stats)
@@ -1068,3 +1445,12 @@ def _prng_key(seed: int) -> np.ndarray:
     import jax
 
     return np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+
+
+def _to_host(tree):
+    """One bulk device->host transfer of a swap payload. `device_get`
+    passes plain numpy through untouched, so fake engines' host pools
+    ride the same path."""
+    import jax
+
+    return jax.device_get(tree)

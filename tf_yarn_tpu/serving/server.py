@@ -5,13 +5,16 @@ framework and the protocol is deliberately tiny:
 
 * ``POST /v1/generate`` — body ``{"prompt": [ids], "max_new_tokens": N,
   "seed": S, "eos_token": E, "priority": P, "timeout_s": T,
-  "stream": bool}``. Non-streamed: one JSON reply with the full token
-  list. ``"stream": true``: a chunked response of one JSON line per
-  token as the scheduler emits it, closed by a ``{"done": true, ...}``
-  summary line — time-to-first-token is the scheduler's, not the
-  drain's. A full admission queue answers 429 with a ``Retry-After``
-  header (backpressure, not buffering); an unservable request
-  (sampling-config mismatch, context overflow) answers 400.
+  "tier": "interactive"|"standard"|"batch", "stream": bool}``.
+  Non-streamed: one JSON reply with the full token list. ``"stream":
+  true``: a chunked response of one JSON line per token as the
+  scheduler emits it, closed by a ``{"done": true, ...}`` summary
+  line — time-to-first-token is the scheduler's, not the drain's. A
+  full admission queue — or a tier at its admission cap — answers 429
+  with a ``Retry-After`` header computed from queue depth over the
+  recent retire rate (backpressure, not buffering); an unservable
+  request (sampling-config mismatch, context overflow, unknown tier)
+  answers 400.
 * ``GET /healthz`` — liveness for load balancers and the watchdog's
   human twin.
 * ``GET /stats`` — the scheduler snapshot + decode-engine compile
@@ -35,7 +38,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tf_yarn_tpu import telemetry
-from tf_yarn_tpu.serving.request import QueueFull, SamplingParams
+from tf_yarn_tpu.serving.request import (
+    DEFAULT_TIER,
+    QueueFull,
+    SamplingParams,
+)
 from tf_yarn_tpu.serving.scheduler import SlotScheduler
 
 _logger = logging.getLogger(__name__)
@@ -183,6 +190,7 @@ def _make_handler(scheduler: SlotScheduler):
                     prompt, params,
                     priority=int(body.get("priority", 0)),
                     timeout_s=timeout_s,
+                    tier=str(body.get("tier", DEFAULT_TIER)),
                 )
             except QueueFull as exc:
                 # Backpressure crosses the wire as a 429 + Retry-After:
@@ -323,6 +331,8 @@ def run_serving(experiment, runtime=None) -> dict:
         decode_attention=experiment.decode_attention,
         prefill_chunk=experiment.prefill_chunk,
         prefill_budget_per_tick=experiment.prefill_budget_per_tick,
+        kv_host_blocks=experiment.kv_host_blocks,
+        tier_caps=experiment.tier_caps,
     )
     server = ServingServer(scheduler, experiment.host, experiment.port)
     scheduler.start()

@@ -433,6 +433,16 @@ def _stale_tpu_fields() -> dict:
             fields[f"last_tpu_fleet_{row_name}_ttft_p95_ms"] = row.get(
                 "ttft_p95_ms"
             )
+            # Observability-plane numbers (PR 18): the scrape-merged
+            # fleet TTFT p95 and the monitor's per-cycle scrape cost.
+            if "fleet_ttft_p95_ms" in row:
+                fields[
+                    f"last_tpu_fleet_{row_name}_merged_ttft_p95_ms"
+                ] = row["fleet_ttft_p95_ms"]
+            if "monitor_scrape_wall_ms" in row:
+                fields[
+                    f"last_tpu_fleet_{row_name}_monitor_scrape_wall_ms"
+                ] = row["monitor_scrape_wall_ms"]
     for key, value in fleet.items():
         if str(key).startswith("scaling_"):
             fields[f"last_tpu_fleet_{key}"] = value

@@ -1401,9 +1401,14 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
     import jax.numpy as jnp
     import numpy as np
 
-    from tf_yarn_tpu import event
+    from tf_yarn_tpu import event, telemetry
     from tf_yarn_tpu.coordination.kv import InProcessKV
-    from tf_yarn_tpu.fleet import ReplicaRegistry, RouterServer, make_policy
+    from tf_yarn_tpu.fleet import (
+        FleetMonitor,
+        ReplicaRegistry,
+        RouterServer,
+        make_policy,
+    )
     from tf_yarn_tpu.models.decode_engine import DecodeEngine
     from tf_yarn_tpu.models.transformer import Transformer, TransformerConfig
     from tf_yarn_tpu.parallel.mesh import select_devices
@@ -1488,6 +1493,10 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
             conn.close()
 
     def run_fleet(n_replicas):
+        # Reset the process registry so this row's fleet-merged sketch
+        # (the in-process replicas share one registry) only holds this
+        # row's observations.
+        telemetry.get_registry().clear()
         kv = InProcessKV()
         replicas = []
         for index in range(n_replicas):
@@ -1506,11 +1515,13 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
             probe_interval_s=0.2,
         )
         registry.refresh(force=True)
+        monitor = FleetMonitor(registry, interval_s=0.2)
         router = RouterServer(
             registry, make_policy("least_loaded"), "127.0.0.1", 0,
-            retries=2,
+            retries=2, monitor=monitor,
         )
         router.start()
+        monitor.start()
         try:
             # Warmup compiles every prompt bucket's prefill + the step
             # program outside the timed window (shared engine: paid
@@ -1557,8 +1568,24 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
                 outcomes.get("ok", 0)
                 for outcomes in router_stats["routed_requests"].values()
             )
+            # The fleet observability plane's own numbers: the
+            # scrape-merged fleet TTFT p95 (server-side, pooled over
+            # every replica's sketch — what the autoscaler sees, vs
+            # the client-side ttft_p95_ms above which includes the
+            # router hop) and the scrape overhead per monitor cycle.
+            aggregate = monitor.poll_once()
+            if aggregate.get("status") == "ok":
+                fleet_ttft = aggregate["histograms"].get(
+                    "serving/ttft_seconds", {})
+                if "p95" in fleet_ttft:
+                    row["fleet_ttft_p95_ms"] = round(
+                        1000 * fleet_ttft["p95"], 2)
+                row["monitor_cycles"] = aggregate["cycle"]
+                row["monitor_scrape_wall_ms"] = round(
+                    1000 * aggregate["scrape_wall_s"], 3)
             return row
         finally:
+            monitor.stop()
             router.stop()
             for _task, scheduler, server in replicas:
                 server.stop()

@@ -31,7 +31,8 @@ HLO_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "hlo")
 # and exercised through the racecheck scenario tests below).
 CONC_STATIC_RULES = ["TYA301", "TYA302", "TYA303"]
 SCENARIO_NAMES = {
-    "serving.slot_scheduler", "ranking.micro_batch", "fleet.registry",
+    "serving.slot_scheduler", "serving.suspend_resume",
+    "ranking.micro_batch", "fleet.registry", "fleet.monitor",
     "telemetry.metrics_spans", "checkpoint.writer",
 }
 

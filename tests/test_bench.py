@@ -68,3 +68,38 @@ def test_uncommitted_detection_in_real_repo():
         assert "BENCH_r01.json" not in uncommitted
     finally:
         os.unlink(scratch)
+
+
+def test_stale_fields_carry_fleet_observability_numbers(tmp_path, monkeypatch):
+    # The fleet section's observability-plane numbers (scrape-merged
+    # TTFT p95, monitor scrape cost) must survive as last_tpu_fleet_*
+    # stale carries, and their absence (an older table) must not break
+    # the carry of the classic fields.
+    table = {
+        "rows": [{"samples_per_sec_per_chip": 1.0, "variant": "base"}],
+        "git_commit": "abc1234",
+        "measured_at": "2026-08-01T00:00:00Z",
+        "fleet": {
+            "rows": {
+                "r2": {
+                    "tokens_per_sec": 42.0,
+                    "ttft_p95_ms": 12.5,
+                    "fleet_ttft_p95_ms": 11.0,
+                    "monitor_scrape_wall_ms": 3.25,
+                },
+                "r1": {"tokens_per_sec": 21.0, "ttft_p95_ms": 10.0},
+            },
+            "scaling_r2_vs_r1": 2.0,
+        },
+    }
+    path = tmp_path / "BENCH_AB.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(bench, "_AB_PATH", str(path))
+    fields = bench._stale_tpu_fields()
+    assert fields["last_tpu_fleet_r2_tokens_per_sec"] == 42.0
+    assert fields["last_tpu_fleet_r2_merged_ttft_p95_ms"] == 11.0
+    assert fields["last_tpu_fleet_r2_monitor_scrape_wall_ms"] == 3.25
+    assert fields["last_tpu_fleet_scaling_r2_vs_r1"] == 2.0
+    # The r1 row predates the observability plane: classic carry only.
+    assert fields["last_tpu_fleet_r1_tokens_per_sec"] == 21.0
+    assert "last_tpu_fleet_r1_merged_ttft_p95_ms" not in fields

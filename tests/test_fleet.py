@@ -378,12 +378,12 @@ def _registry_over(endpoints, **kwargs):
     return registry, probe
 
 
-def _post(port, body, timeout=120):
+def _post(port, body, timeout=120, headers=None):
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     try:
         conn.request(
             "POST", "/v1/generate", json.dumps(body),
-            {"Content-Type": "application/json"},
+            {"Content-Type": "application/json", **(headers or {})},
         )
         resp = conn.getresponse()
         return resp.status, dict(resp.getheaders()), resp.read()
@@ -397,6 +397,16 @@ def _get(port, path, timeout=30):
         conn.request("GET", path)
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get_text(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read().decode()
     finally:
         conn.close()
 
@@ -856,6 +866,167 @@ def test_fleet_end_to_end_matches_legacy_and_survives_replica_kill():
             replica["scheduler"].close()
 
 
+def test_fleet_observability_plane_end_to_end():
+    """The observability acceptance bar: 2 real replicas + router +
+    FleetMonitor under concurrent traffic. The router's /metrics serves
+    a fleet-merged serving/ttft_seconds p95 equal to the pooled
+    per-replica bucket merge — asserted against an oracle recomputed
+    from the raw TTFT timings (within HIST_ALPHA relative error) — and
+    one X-Request-Id appears in BOTH the router's span records and the
+    owning replica's scheduler trace ring for the same request."""
+    import re
+
+    from tf_yarn_tpu import telemetry
+    from tf_yarn_tpu.fleet import FleetMonitor
+    from tf_yarn_tpu.telemetry.registry import HIST_ALPHA
+
+    model, params, _kv, replicas, registry = _tiny_fleet(n_replicas=2)
+    monitor = FleetMonitor(
+        registry, interval_s=0.2, slo={"ttft_p95_s": 60.0})
+    router = RouterServer(
+        registry, make_policy("round_robin"), "127.0.0.1", 0, retries=3,
+        monitor=monitor,
+    )
+    router.start()
+    monitor.start()
+    metrics = telemetry.get_registry()
+    try:
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 256, (n,)).tolist()
+                   for n in (4, 7, 3, 6, 5, 8)]
+
+        # Warm the (shared) engine through the router so compiles land
+        # outside the measured window, then reset the process registry:
+        # the sketch under test starts empty.
+        warm = [threading.Thread(target=_post, args=(
+            router.port, {"prompt": p, "max_new_tokens": 4}, 300,
+        )) for p in prompts[:4]]
+        for t in warm:
+            t.start()
+        for t in warm:
+            t.join(timeout=600)
+        metrics.clear()
+
+        # Spy on the shared TTFT histogram: every raw server-side TTFT
+        # observation is the oracle the merged sketch must reproduce.
+        hist = metrics.histogram("serving/ttft_seconds")
+        raw_ttft = []
+        real_observe = hist.observe
+        hist.observe = lambda value: (raw_ttft.append(float(value)),
+                                      real_observe(value))[-1]
+
+        # Concurrent traffic; half the callers supply their own
+        # X-Request-Id, the rest let the router mint one.
+        results = {}
+
+        def call(index):
+            body = {"prompt": prompts[index % len(prompts)],
+                    "max_new_tokens": 4 + index % 3}
+            headers = ({"X-Request-Id": f"req-caller-{index}"}
+                       if index % 2 else None)
+            results[index] = _post(router.port, body, 300, headers)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        del hist.observe  # un-spy before the final scrape settles
+        assert len(results) == 8
+        rids = {}
+        for index, (status, headers, raw) in results.items():
+            assert status == 200, raw
+            rids[index] = headers["X-Request-Id"]
+        # Caller-supplied ids are honored verbatim; minted ones are
+        # unique req-<hex>.
+        assert rids[1] == "req-caller-1" and rids[3] == "req-caller-3"
+        assert all(rid.startswith("req-") for rid in rids.values())
+        assert len(set(rids.values())) == 8
+
+        # A deterministic final cycle AFTER all traffic: both scrapes
+        # see the complete windowed sketch.
+        aggregate = monitor.poll_once()
+        assert aggregate["status"] == "ok"
+        assert aggregate["contributing_replicas"] == 2
+        assert aggregate["stale_replicas"] == 0
+        merged = aggregate["histograms"]["serving/ttft_seconds"]
+        # In-process replicas share ONE registry, so each /stats ships
+        # the same sketch and the pooled merge is every raw timing
+        # twice — which leaves every quantile untouched.
+        assert merged["count"] == 2 * len(raw_ttft)
+        pooled = sorted(raw_ttft * 2)
+        oracle_p95 = pooled[int(0.95 * (len(pooled) - 1))]
+        assert abs(merged["p95"] - oracle_p95) / oracle_p95 <= HIST_ALPHA
+
+        # The router's /metrics serves the SAME fleet-merged p95.
+        status, headers, text = _get_text(router.port, "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        match = re.search(
+            r'^fleet_serving_ttft_seconds\{agg="p95"\} (\S+)$',
+            text, re.M)
+        assert match, text
+        assert float(match.group(1)) == merged["p95"]
+        assert abs(float(match.group(1)) - oracle_p95) / oracle_p95 \
+            <= HIST_ALPHA
+        # Satellite: the router's own request histogram, in /metrics...
+        assert re.search(
+            r'fleet_routed_request_seconds_count\{outcome="ok",'
+            r'path="/v1/generate"\} 8.0', text), text
+        # ...and in /stats signals, next to the embedded fleet aggregate.
+        status, stats = _get(router.port, "/stats")
+        assert status == 200
+        assert stats["schema_version"] == telemetry.STATS_SCHEMA_VERSION
+        assert stats["signals"]["version"] == telemetry.SIGNALS_VERSION
+        routed_sig = stats["signals"]["histograms"][
+            "fleet/routed_request_seconds{outcome=ok,path=/v1/generate}"]
+        assert routed_sig["count"] == 8
+        assert stats["fleet"]["status"] == "ok"
+        assert stats["fleet"]["slo"]["ttft_p95_s"]["status"] == "ok"
+        # Replica /healthz now carries the payload schema version, and
+        # the registry parsed it off the probe.
+        status, health = _get(router.port, "/healthz")
+        assert health["schema_version"] == telemetry.STATS_SCHEMA_VERSION
+        assert registry.get("serving:0").schema_version == \
+            telemetry.STATS_SCHEMA_VERSION
+
+        # Cross-task tracing: one request id, BOTH sides. The router's
+        # span records it...
+        rid = rids[1]
+        spans = telemetry.get_tracer().records()
+        router_spans = [s for s in spans if s.name == "router/route"
+                        and s.args.get("request_id") == rid]
+        assert len(router_spans) == 1
+        # ...the owning replica's submit span tags it...
+        submit_spans = [s for s in spans if s.name == "serving/submit"
+                        and s.args.get("request_id") == rid]
+        assert len(submit_spans) == 1
+        # ...and the owning replica's scheduler trace ring carries it
+        # against the scheduler-local request id — on EXACTLY one
+        # replica (the one the router routed to).
+        owners = [
+            r["task"] for r in replicas
+            if any(rid in entry.get("trace", {}).values()
+                   for entry in list(r["scheduler"].trace))
+        ]
+        assert len(owners) == 1
+        # Every request id made it into some trace ring.
+        ring_ids = {
+            trace_id
+            for r in replicas
+            for entry in list(r["scheduler"].trace)
+            for trace_id in entry.get("trace", {}).values()
+        }
+        assert set(rids.values()) <= ring_ids
+    finally:
+        monitor.stop()
+        router.stop()
+        for replica in replicas:
+            replica["server"].stop()
+            replica["scheduler"].close()
+
+
 # --------------------------------------------------------------------------
 # the fleet bench reports aggregate throughput per replica count
 # --------------------------------------------------------------------------
@@ -882,6 +1053,10 @@ def test_bench_fleet_reports_scaling_rows():
         assert rows[name]["tokens_per_sec"] > 0
         assert rows[name]["routed_ok"] == 3
         assert "ttft_p95_ms" in rows[name]
+        # The observability plane's scrape-merged numbers ride along.
+        assert rows[name]["fleet_ttft_p95_ms"] > 0
+        assert rows[name]["monitor_cycles"] >= 1
+        assert rows[name]["monitor_scrape_wall_ms"] >= 0
     assert rows["r2"]["healthy_replicas"] == 2
     # The scaling ratio is REPORTED (its value is rig-dependent: on one
     # shared CPU the replicas contend, on real chips they scale).

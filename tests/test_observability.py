@@ -1,0 +1,681 @@
+"""Fleet observability plane: the mergeable quantile histogram, the
+exposition formats, the SLO engine, and the fleet monitor's
+degradation ladder.
+
+The load-bearing numeric contract is the DDSketch-style error bound:
+with gamma = (1+alpha)/(1-alpha) log buckets, ANY quantile estimate is
+within HIST_ALPHA (5%) RELATIVE error of a true sample value — so a
+fleet p95 built by merging replica bucket sketches is a TRUE pooled
+quantile with the same bound, which no max-of-p95s or averaged-p95
+scheme can offer. The tests here assert that bound directly against a
+sorted-sample oracle, pin merge algebra (commutative, associative,
+merge-of-shards == observe-pooled), and drive the monitor through the
+mixed-version / scrape-failure / empty-fleet degradations with fakes.
+The end-to-end wire test (real replicas + router over HTTP) lives in
+test_fleet.py.
+"""
+
+import math
+import random
+import threading
+
+import pytest
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.coordination.kv import InProcessKV
+from tf_yarn_tpu.telemetry.exposition import (
+    PROMETHEUS_CONTENT_TYPE,
+    SIGNALS_VERSION,
+    STATS_SCHEMA_VERSION,
+    render_prometheus,
+    signals_block,
+)
+from tf_yarn_tpu.telemetry.registry import (
+    HIST_ALPHA,
+    HIST_WINDOW_S,
+    Histogram,
+    MetricsRegistry,
+)
+from tf_yarn_tpu.telemetry.slo import SloEvaluator, parse_slo
+
+
+def _oracle(sorted_vals, q):
+    # Nearest-rank at rank q*(n-1): the sketch's quantile convention.
+    return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+
+# --------------------------------------------------------------------------
+# histogram sketch: error bound, merge algebra, window, wire form
+# --------------------------------------------------------------------------
+
+def test_histogram_quantile_error_bound():
+    """The stated bound: every quantile estimate is within HIST_ALPHA
+    (5%) relative error of the true sample quantile, for a skewed
+    latency-shaped distribution."""
+    rng = random.Random(7)
+    vals = [rng.lognormvariate(0.0, 1.5) for _ in range(5000)]
+    hist = Histogram()
+    for v in vals:
+        hist.observe(v)
+    sv = sorted(vals)
+    for q in (0.01, 0.5, 0.9, 0.95, 0.99):
+        est = hist.quantile(q)
+        true = _oracle(sv, q)
+        assert abs(est - true) / true <= HIST_ALPHA, (q, est, true)
+    # Edges exact-ish too.
+    assert hist.count == 5000
+    assert abs(hist.total - sum(vals)) < 1e-6
+    assert hist.min == min(vals) and hist.max == max(vals)
+
+
+def test_histogram_quantile_empty_and_zero_bucket():
+    hist = Histogram()
+    assert hist.quantile(0.95) is None
+    hist.observe(0.0)
+    hist.observe(0.0)
+    assert hist.quantile(0.5) == 0.0  # zero bucket reports exactly 0
+    assert hist.summary()["count"] == 2.0
+
+
+def test_histogram_merge_commutative_associative_matches_pooled():
+    """Merging replica shards is order-independent and equals observing
+    the pooled stream directly — the property that makes the fleet p95
+    a true pooled quantile."""
+    rng = random.Random(11)
+    vals = [rng.expovariate(3.0) for _ in range(3000)]
+    shards = [Histogram() for _ in range(3)]
+    for i, v in enumerate(vals):
+        shards[i % 3].observe(v)
+    pooled = Histogram()
+    for v in vals:
+        pooled.observe(v)
+
+    def merged(order):
+        out = Histogram()
+        for i in order:
+            out.merge(shards[i])
+        return out
+
+    a = merged([0, 1, 2])
+    b = merged([2, 0, 1])
+    # (s0 + s1) + s2 vs s0 + (s1 + s2), built pairwise.
+    left = Histogram().merge(shards[0]).merge(shards[1]).merge(shards[2])
+    right_tail = Histogram().merge(shards[1]).merge(shards[2])
+    right = Histogram().merge(shards[0]).merge(right_tail)
+    for q in (0.5, 0.9, 0.95, 0.99):
+        assert a.quantile(q) == b.quantile(q) == left.quantile(q) \
+            == right.quantile(q) == pooled.quantile(q)
+    assert a.count == pooled.count == len(vals)
+    assert abs(a.total - pooled.total) < 1e-6
+    assert a.min == pooled.min and a.max == pooled.max
+    # merge() must leave its argument intact, and reject self-merge.
+    assert shards[0].count == len([v for i, v in enumerate(vals)
+                                   if i % 3 == 0])
+    with pytest.raises(ValueError, match="itself"):
+        a.merge(a)
+
+
+def test_histogram_merged_shards_hold_error_bound():
+    rng = random.Random(23)
+    vals = [rng.lognormvariate(-1.0, 1.0) for _ in range(4000)]
+    shards = [Histogram() for _ in range(5)]
+    for i, v in enumerate(vals):
+        shards[rng.randrange(5)].observe(v)
+    fleet = Histogram()
+    for s in shards:
+        fleet.merge(s)
+    sv = sorted(vals)
+    for q in (0.5, 0.95, 0.99):
+        est = fleet.quantile(q)
+        true = _oracle(sv, q)
+        assert abs(est - true) / true <= HIST_ALPHA, (q, est, true)
+
+
+def test_histogram_sliding_window_expires_old_observations(monkeypatch):
+    """Windowed quantiles cover only the recent HIST_WINDOW_S; lifetime
+    stats keep everything."""
+    clock = [1000.0]
+    monkeypatch.setattr(
+        "tf_yarn_tpu.telemetry.registry.time.monotonic",
+        lambda: clock[0],
+    )
+    hist = Histogram()
+    hist.observe(100.0)  # the "old" observation
+    clock[0] += HIST_WINDOW_S * 2  # well past the window
+    hist.observe(1.3)
+    w95 = hist.quantile(0.95, window=True)
+    assert w95 is not None and abs(w95 - 1.3) / 1.3 <= HIST_ALPHA
+    # Lifetime still sees both (q=1.0 is the max, i.e. the old value).
+    assert hist.count == 2
+    assert hist.quantile(1.0) > 50.0
+    # The wire form is windowed by default: only the recent count ships.
+    assert hist.to_signal()["count"] == 1
+    assert hist.to_signal(window=False)["count"] == 2
+
+
+def test_histogram_drops_non_finite_observations():
+    """Satellite regression: NaN/inf observations are dropped and
+    counted in telemetry/dropped_observations_total instead of
+    poisoning min/max/mean/quantiles."""
+    dropped = telemetry.get_registry().counter(
+        "telemetry/dropped_observations_total")
+    before = dropped.value
+    hist = Histogram()
+    hist.observe(2.0)
+    hist.observe(float("nan"))
+    hist.observe(float("inf"))
+    hist.observe(float("-inf"))
+    hist.observe(4.0)
+    assert dropped.value == before + 3
+    summ = hist.summary()
+    assert summ["count"] == 2.0
+    assert summ["min"] == 2.0 and summ["max"] == 4.0
+    assert summ["mean"] == 3.0
+    assert math.isfinite(hist.quantile(0.95))
+
+
+def test_histogram_summary_and_snapshot_keys_backcompat():
+    """The old summary contract is intact: empty histograms report
+    exactly {count, sum}; observed ones the old six keys plus the new
+    quantiles. Registry snapshots keep the old suffixed keys."""
+    hist = Histogram()
+    assert hist.summary() == {"count": 0.0, "sum": 0.0}
+    hist.observe(1.0)
+    hist.observe(3.0)
+    assert set(hist.summary()) == {
+        "count", "sum", "mean", "min", "max", "last",
+        "p50", "p95", "p99",
+    }
+    assert hist.summary()["last"] == 3.0
+
+    registry = MetricsRegistry()
+    registry.histogram("serving/ttft_seconds").observe(0.25)
+    registry.histogram("serving/ttft_seconds", tier="interactive")
+    snap = registry.snapshot()
+    for suffix in ("count", "sum", "mean", "min", "max", "last",
+                   "p50", "p95", "p99"):
+        assert f"serving/ttft_seconds_{suffix}" in snap
+    # Empty labeled histogram: old empty contract, labels preserved.
+    assert snap["serving/ttft_seconds_count{tier=interactive}"] == 0.0
+    assert "serving/ttft_seconds_p50{tier=interactive}" not in snap
+
+
+def test_histogram_signal_round_trip_and_malformed_tolerance():
+    rng = random.Random(3)
+    hist = Histogram()
+    for _ in range(500):
+        hist.observe(rng.expovariate(1.0))
+    hist.observe(0.0)
+    wire = hist.to_signal(window=False)
+    back = Histogram.from_signal(wire)
+    assert back is not None
+    assert back.count == hist.count
+    assert back.min == hist.min and back.max == hist.max
+    for q in (0.5, 0.95, 0.99):
+        assert back.quantile(q) == hist.quantile(q)
+    # from_signal NEVER raises — malformed/mixed-version payloads
+    # degrade to "contributes nothing" (None).
+    assert Histogram.from_signal(None) is None
+    assert Histogram.from_signal("nope") is None
+    assert Histogram.from_signal({}) is None
+    assert Histogram.from_signal(
+        {**wire, "scheme": {"alpha": 0.01, "version": 1}}) is None
+    assert Histogram.from_signal(
+        {**wire, "scheme": {"alpha": HIST_ALPHA, "version": 99}}) is None
+    assert Histogram.from_signal({**wire, "count": -5}) is None
+    assert Histogram.from_signal({**wire, "buckets": [[1, -2]]}) is None
+    assert Histogram.from_signal({**wire, "buckets": "garbage"}) is None
+    assert Histogram.from_signal({**wire, "sum": "many"}) is None
+
+
+def test_histogram_concurrent_observe_and_merge_is_consistent():
+    """Writer threads + a merging reader: totals conserved, no
+    deadlock (merge snapshots `other` without nesting locks)."""
+    src = Histogram()
+    done = threading.Event()
+
+    def write():
+        for i in range(2000):
+            src.observe(0.001 * (i % 100 + 1))
+
+    threads = [threading.Thread(target=write) for _ in range(4)]
+    for t in threads:
+        t.start()
+    sink = Histogram()
+    while not done.is_set():
+        sink_copy = Histogram().merge(src)
+        assert sink_copy.count <= 8000
+        if all(not t.is_alive() for t in threads):
+            done.set()
+    for t in threads:
+        t.join()
+    sink.merge(src)
+    assert sink.count == 8000
+    assert abs(sink.total - sum(
+        0.001 * (i % 100 + 1) for i in range(2000)) * 4) < 1e-6
+
+
+# --------------------------------------------------------------------------
+# exposition: /metrics text format + the versioned signals block
+# --------------------------------------------------------------------------
+
+def test_render_prometheus_text_format():
+    registry = MetricsRegistry()
+    registry.counter("fleet/requests_total", outcome="ok").inc(3)
+    registry.gauge("serving/active_slots").set(2)
+    hist = registry.histogram("serving/ttft_seconds")
+    for v in (0.1, 0.2, 0.3, 0.4):
+        hist.observe(v)
+    text = render_prometheus(registry)
+    lines = text.splitlines()
+    assert "# TYPE fleet_requests_total counter" in lines
+    assert 'fleet_requests_total{outcome="ok"} 3.0' in lines
+    assert "# TYPE serving_active_slots gauge" in lines
+    assert "serving_active_slots 2.0" in lines
+    assert "# TYPE serving_ttft_seconds summary" in lines
+    assert any(l.startswith('serving_ttft_seconds{quantile="0.95"} ')
+               for l in lines)
+    assert "serving_ttft_seconds_count 4.0" in lines
+    assert any(l.startswith("serving_ttft_seconds_sum 1.0") for l in lines)
+    # One TYPE line per family, names fully sanitized, trailing newline.
+    assert text.endswith("\n")
+    assert sum(1 for l in lines if l == "# TYPE serving_ttft_seconds summary") == 1
+    assert "/" not in "".join(l.split()[0] for l in lines if l)
+    assert "0.0.4" in PROMETHEUS_CONTENT_TYPE
+
+
+def test_signals_block_prefixes_and_version():
+    registry = MetricsRegistry()
+    registry.histogram("serving/ttft_seconds").observe(0.2)
+    registry.histogram("ranking/request_seconds").observe(0.5)
+    registry.counter("serving/requests_total").inc()
+    block = signals_block(registry, prefixes=("serving/",))
+    assert block["version"] == SIGNALS_VERSION
+    assert set(block["histograms"]) == {"serving/ttft_seconds"}
+    assert set(block["scalars"]) == {"serving/requests_total"}
+    sig = block["histograms"]["serving/ttft_seconds"]
+    assert sig["scheme"]["alpha"] == HIST_ALPHA
+    assert Histogram.from_signal(sig).count == 1
+    # No prefix filter: everything ships.
+    assert set(signals_block(registry)["histograms"]) == {
+        "ranking/request_seconds", "serving/ttft_seconds"}
+    assert STATS_SCHEMA_VERSION == 2
+
+
+# --------------------------------------------------------------------------
+# SLO grammar + evaluator
+# --------------------------------------------------------------------------
+
+def test_parse_slo_objectives():
+    objectives = parse_slo({
+        "interactive_ttft_p95_s": 0.5,
+        "itl_p99_ms": 80.0,
+        "rank_p90_s": 0.2,
+    })
+    by_name = {o.name: o for o in objectives}
+    tiered = by_name["interactive_ttft_p95_s"]
+    assert tiered.metric == "serving/ttft_seconds"
+    assert tiered.labels == (("tier", "interactive"),)
+    assert tiered.quantile == 0.95 and tiered.threshold == 0.5
+    assert tiered.key == "serving/ttft_seconds{tier=interactive}"
+    assert by_name["itl_p99_ms"].metric == "serving/inter_token_latency_ms"
+    assert by_name["itl_p99_ms"].labels == ()
+    assert by_name["rank_p90_s"].metric == "ranking/request_seconds"
+
+
+@pytest.mark.parametrize("bad,match", [
+    ({"bogus": 1.0}, "does not match"),
+    ({"ttft_p95_ms": 1.0}, "measured in 's'"),
+    ({"itl_p99_s": 1.0}, "measured in 'ms'"),
+    ({"ttft_p0_s": 1.0}, "percentile"),
+    ({"ttft_p95_s": "fast"}, "number"),
+    ({"ttft_p95_s": -1.0}, "> 0"),
+])
+def test_parse_slo_rejects_bad_objectives(bad, match):
+    with pytest.raises(ValueError, match=match):
+        parse_slo(bad)
+    # The offending key is always named.
+    with pytest.raises(ValueError, match=next(iter(bad))):
+        parse_slo(bad)
+
+
+def test_serving_experiment_slo_knob_validates():
+    from tf_yarn_tpu.experiment import ServingExperiment
+
+    exp = ServingExperiment(
+        model=None, model_dir="x",
+        slo={"interactive_ttft_p95_s": 0.5},
+    )
+    assert exp.slo == {"interactive_ttft_p95_s": 0.5}
+    assert ServingExperiment(model=None, model_dir="x").slo is None
+    with pytest.raises(ValueError, match="slo.*bogus"):
+        ServingExperiment(model=None, model_dir="x", slo={"bogus": 1.0})
+
+
+def test_slo_evaluator_attainment_burn_and_no_data():
+    registry = MetricsRegistry()
+    evaluator = SloEvaluator(
+        parse_slo({"ttft_p95_s": 0.5}), registry, scope="replica")
+    burn = registry.counter("slo/burn_total", objective="ttft_p95_s",
+                            scope="replica")
+    # No traffic yet: no_data, and absence of traffic is NOT a burn.
+    report = evaluator.evaluate()
+    assert report["ttft_p95_s"]["status"] == "no_data"
+    assert burn.value == 0.0
+    assert "slo/attainment{objective=ttft_p95_s,scope=replica}" \
+        not in registry.snapshot()
+    # Fast traffic: attained.
+    hist = registry.histogram("serving/ttft_seconds")
+    for _ in range(50):
+        hist.observe(0.1)
+    report = evaluator.evaluate()
+    assert report["ttft_p95_s"]["status"] == "ok"
+    assert report["ttft_p95_s"]["value"] <= 0.5
+    attainment = registry.gauge("slo/attainment", objective="ttft_p95_s",
+                                scope="replica")
+    assert attainment.value == 1.0 and burn.value == 0.0
+    # Slow traffic: violated — attainment 0, one burn per evaluation.
+    for _ in range(200):
+        hist.observe(2.0)
+    assert evaluator.evaluate()["ttft_p95_s"]["status"] == "violated"
+    evaluator.evaluate()
+    assert attainment.value == 0.0 and burn.value == 2.0
+    assert evaluator.report()["ttft_p95_s"]["status"] == "violated"
+
+
+def test_slo_evaluator_windowed_not_lifetime(monkeypatch):
+    """An SLO describes NOW: a bad spike that has aged out of the
+    sliding window no longer violates, even though lifetime p95 would."""
+    clock = [5000.0]
+    monkeypatch.setattr(
+        "tf_yarn_tpu.telemetry.registry.time.monotonic",
+        lambda: clock[0],
+    )
+    registry = MetricsRegistry()
+    evaluator = SloEvaluator(parse_slo({"ttft_p95_s": 0.5}), registry)
+    hist = registry.histogram("serving/ttft_seconds")
+    for _ in range(100):
+        hist.observe(3.0)  # the bad spike
+    assert evaluator.evaluate()["ttft_p95_s"]["status"] == "violated"
+    clock[0] += HIST_WINDOW_S * 2
+    for _ in range(20):
+        hist.observe(0.1)
+    assert evaluator.evaluate()["ttft_p95_s"]["status"] == "ok"
+    # Lifetime p95 is still dominated by the spike — the window is load-
+    # bearing here.
+    assert hist.quantile(0.95) > 0.5
+
+
+def test_slo_evaluator_rate_limit_and_fleet_scope():
+    ticks = [0.0]
+    registry = MetricsRegistry()
+    evaluator = SloEvaluator(
+        parse_slo({"ttft_p95_s": 0.5}), registry,
+        scope="fleet", min_interval_s=1.0, clock=lambda: ticks[0],
+    )
+    merged = Histogram()
+    for _ in range(100):
+        merged.observe(2.0)
+    fleet_hists = {"serving/ttft_seconds": merged}
+    assert evaluator.evaluate(histograms=fleet_hists)[
+        "ttft_p95_s"]["status"] == "violated"
+    assert registry.counter("slo/burn_total", objective="ttft_p95_s",
+                            scope="fleet").value == 1.0
+    # Within the interval: rate-limited.
+    ticks[0] += 0.5
+    assert evaluator.maybe_evaluate() is None
+    ticks[0] += 1.0
+    assert evaluator.maybe_evaluate() is not None
+
+
+# --------------------------------------------------------------------------
+# fleet monitor: merge, degradation ladder, lifecycle
+# --------------------------------------------------------------------------
+
+class FakeFleet:
+    """The monitor's registry contract: healthy() + probe cadence."""
+
+    probe_interval_s = 0.05
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def healthy(self):
+        return list(self.replicas)
+
+
+class FakeReplica:
+    def __init__(self, task, endpoint, kind="generate"):
+        self.task = task
+        self.endpoint = endpoint
+        self.kind = kind
+
+
+class ScrapeScript:
+    """Injectable /stats scrape steered per endpoint, like ProbeScript."""
+
+    def __init__(self):
+        self.responses = {}
+
+    def set(self, endpoint, response):
+        self.responses[endpoint] = response
+
+    def __call__(self, endpoint):
+        response = self.responses.get(
+            endpoint, ConnectionRefusedError(f"no script for {endpoint}"))
+        if isinstance(response, Exception):
+            raise response
+        return response
+
+
+def _stats_payload(values):
+    hist = Histogram()
+    for v in values:
+        hist.observe(v)
+    return {
+        "schema_version": STATS_SCHEMA_VERSION,
+        "signals": {
+            "version": SIGNALS_VERSION,
+            "histograms": {
+                "serving/ttft_seconds": hist.to_signal(window=False),
+            },
+            "scalars": {},
+        },
+    }
+
+
+def _two_replica_monitor(slo=None):
+    from tf_yarn_tpu.fleet import FleetMonitor
+
+    fleet = FakeFleet([
+        FakeReplica("serving:0", "127.0.0.1:9100"),
+        FakeReplica("serving:1", "127.0.0.1:9101"),
+    ])
+    scrape = ScrapeScript()
+    monitor = FleetMonitor(fleet, scrape=scrape, interval_s=0.01, slo=slo)
+    return fleet, scrape, monitor
+
+
+def test_monitor_merges_replicas_into_pooled_quantiles():
+    _, scrape, monitor = _two_replica_monitor(slo={"ttft_p95_s": 50.0})
+    vals_a = [0.1 * i for i in range(1, 60)]
+    vals_b = [0.5 * i for i in range(1, 40)]
+    scrape.set("127.0.0.1:9100", _stats_payload(vals_a))
+    scrape.set("127.0.0.1:9101", _stats_payload(vals_b))
+    aggregate = monitor.poll_once()
+    assert aggregate["status"] == "ok"
+    assert aggregate["contributing_replicas"] == 2
+    assert aggregate["stale_replicas"] == 0
+    pooled = sorted(vals_a + vals_b)
+    got = aggregate["histograms"]["serving/ttft_seconds"]
+    assert got["count"] == len(pooled)
+    for label, q in (("p50", 0.5), ("p95", 0.95)):
+        true = _oracle(pooled, q)
+        assert abs(got[label] - true) / true <= HIST_ALPHA
+    # Published as fleet/ gauges for the router's /metrics.
+    metrics = telemetry.get_registry()
+    p95 = metrics.gauge("fleet/serving/ttft_seconds", agg="p95").value
+    assert abs(p95 - _oracle(pooled, 0.95)) / _oracle(pooled, 0.95) \
+        <= HIST_ALPHA
+    assert metrics.gauge("fleet/serving/ttft_seconds",
+                         agg="count").value == len(pooled)
+    # Fleet-scope SLO evaluated over the merged sketch.
+    assert aggregate["slo"]["ttft_p95_s"]["status"] == "ok"
+
+
+def test_monitor_scrape_failure_falls_back_last_good_then_recovers():
+    """The degradation ladder: a failed scrape keeps that replica's
+    last-good signals in the merge marked stale; recovery re-enters
+    with fresh signals; never-scraped replicas merge nothing."""
+    _, scrape, monitor = _two_replica_monitor()
+    scrape.set("127.0.0.1:9100", _stats_payload([0.1] * 10))
+    scrape.set("127.0.0.1:9101", _stats_payload([0.2] * 10))
+    first = monitor.poll_once()
+    assert first["status"] == "ok" and first["stale_replicas"] == 0
+    # Replica 1 stops answering: its last-good still contributes.
+    scrape.set("127.0.0.1:9101", ConnectionResetError("mid-rollout"))
+    degraded = monitor.poll_once()
+    assert degraded["status"] == "ok"
+    assert degraded["stale_replicas"] == 1
+    assert degraded["replicas"]["serving:1"]["stale"] is True
+    assert degraded["replicas"]["serving:0"]["stale"] is False
+    assert degraded["histograms"]["serving/ttft_seconds"]["count"] == 20
+    assert telemetry.get_registry().gauge(
+        "fleet/monitor_stale_replicas").value == 1
+    # Recovery: fresh signals, stale clears.
+    scrape.set("127.0.0.1:9101", _stats_payload([0.2] * 15))
+    recovered = monitor.poll_once()
+    assert recovered["stale_replicas"] == 0
+    assert recovered["replicas"]["serving:1"]["stale"] is False
+    assert recovered["histograms"]["serving/ttft_seconds"]["count"] == 25
+    assert telemetry.get_registry().counter(
+        "fleet/monitor_scrapes_total", outcome="error").value >= 1
+
+
+def test_monitor_empty_fleet_reports_no_data_never_zeros():
+    """An empty fleet (or one that has never answered a scrape) is an
+    explicit no_data — a fabricated zero p95 would read as 'infinitely
+    fast' to the autoscaler."""
+    from tf_yarn_tpu.fleet import FleetMonitor
+
+    monitor = FleetMonitor(FakeFleet([]), scrape=ScrapeScript(),
+                           interval_s=0.01)
+    aggregate = monitor.poll_once()
+    assert aggregate["status"] == "no_data"
+    assert "histograms" not in aggregate
+    # A fleet whose only replica has NEVER answered: still no_data (no
+    # last-good to fall back to), replica reported unobserved.
+    _, scrape, monitor = _two_replica_monitor()
+    never = monitor.poll_once()
+    assert never["status"] == "no_data"
+    assert never["replicas"]["serving:0"]["signals"] == "never_scraped"
+    assert never["stale_replicas"] == 2
+
+
+def test_monitor_default_scrape_interval_is_floored():
+    """A defaulted monitor piggybacks on the registry's probe cadence
+    but never inherits a sub-second one: a /stats scrape serializes
+    every replica's sketches, so a 50ms health-probe interval must not
+    turn the monitor into a 20Hz load generator. An explicit
+    ``interval_s=`` stays honored verbatim (tests and benches rely on
+    fast cycles)."""
+    from tf_yarn_tpu.fleet import FleetMonitor
+    from tf_yarn_tpu.fleet.monitor import MIN_DEFAULT_INTERVAL_S
+
+    defaulted = FleetMonitor(FakeFleet([]), scrape=ScrapeScript())
+    assert FakeFleet.probe_interval_s < MIN_DEFAULT_INTERVAL_S
+    assert defaulted.interval_s == MIN_DEFAULT_INTERVAL_S
+
+    slow_fleet = FakeFleet([])
+    slow_fleet.probe_interval_s = 30.0
+    assert FleetMonitor(slow_fleet, scrape=ScrapeScript()).interval_s == 30.0
+
+    explicit = FleetMonitor(FakeFleet([]), scrape=ScrapeScript(),
+                            interval_s=0.01)
+    assert explicit.interval_s == 0.01
+
+
+def test_monitor_tolerates_legacy_and_malformed_replicas():
+    """Mixed-version rollout: a pre-observability replica (no
+    schema_version, no signals) stays in the fleet view as `legacy` and
+    contributes nothing; a replica shipping an incompatible sketch
+    scheme contributes nothing; the modern replica's signals still
+    aggregate."""
+    _, scrape, monitor = _two_replica_monitor()
+    scrape.set("127.0.0.1:9100", _stats_payload([0.3] * 12))
+    scrape.set("127.0.0.1:9101", {"queue_depth": 0})  # old /stats shape
+    aggregate = monitor.poll_once()
+    assert aggregate["status"] == "ok"
+    assert aggregate["replicas"]["serving:1"]["legacy"] is True
+    assert aggregate["replicas"]["serving:1"]["schema_version"] is None
+    assert aggregate["histograms"]["serving/ttft_seconds"]["count"] == 12
+    # Incompatible sketch scheme: dropped, not crashed.
+    bad = _stats_payload([0.4] * 9)
+    bad["signals"]["histograms"]["serving/ttft_seconds"]["scheme"] = {
+        "alpha": 0.01, "version": 1}
+    scrape.set("127.0.0.1:9101", bad)
+    aggregate = monitor.poll_once()
+    assert aggregate["status"] == "ok"
+    assert aggregate["histograms"]["serving/ttft_seconds"]["count"] == 12
+
+
+def test_monitor_thread_lifecycle_joined():
+    """TYA303 contract: start() spawns the scrape thread, stop() joins
+    it; cycles advance while running."""
+    _, scrape, monitor = _two_replica_monitor()
+    scrape.set("127.0.0.1:9100", _stats_payload([0.1]))
+    scrape.set("127.0.0.1:9101", _stats_payload([0.2]))
+    monitor.start()
+    try:
+        deadline = 50
+        while monitor.aggregate().get("cycle", 0) < 2 and deadline:
+            deadline -= 1
+            threading.Event().wait(0.02)
+        assert monitor.aggregate().get("cycle", 0) >= 2
+    finally:
+        monitor.stop()
+    assert monitor._thread is None
+    cycle = monitor.aggregate()["cycle"]
+    threading.Event().wait(0.05)
+    assert monitor.aggregate()["cycle"] == cycle  # really stopped
+    monitor.stop()  # idempotent
+
+
+# --------------------------------------------------------------------------
+# replica registry: schema_version tolerance (mixed-version fleets)
+# --------------------------------------------------------------------------
+
+def test_registry_parses_and_tolerates_schema_versions():
+    """Satellite: /healthz payloads with a modern schema_version, a
+    legacy payload without one, and a garbage version are ALL admitted —
+    the version informs readers, it never gates health."""
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.fleet import HEALTHY, ReplicaRegistry
+
+    kv = InProcessKV()
+    responses = {
+        "127.0.0.1:9300": {"status": "ok", "queue_depth": 0,
+                           "active_slots": 0,
+                           "schema_version": STATS_SCHEMA_VERSION},
+        "127.0.0.1:9301": {"status": "ok", "queue_depth": 0,
+                           "active_slots": 0},  # legacy: no version
+        "127.0.0.1:9302": {"status": "ok", "queue_depth": 0,
+                           "active_slots": 0, "schema_version": "soon"},
+    }
+    for index, endpoint in enumerate(sorted(responses)):
+        task = f"serving:{index}"
+        event.serving_endpoint_event(kv, task, endpoint)
+        event.heartbeat_event(kv, task)
+    registry = ReplicaRegistry(
+        kv, tasks=[f"serving:{i}" for i in range(3)],
+        probe=lambda endpoint: dict(responses[endpoint]),
+        probe_interval_s=0.0,
+    )
+    healthy = registry.refresh(force=True)
+    assert len(healthy) == 3
+    assert registry.get("serving:0").schema_version == STATS_SCHEMA_VERSION
+    assert registry.get("serving:1").schema_version is None  # legacy
+    assert registry.get("serving:2").schema_version is None  # garbage
+    assert all(r.state == HEALTHY for r in healthy)
+    assert registry.get("serving:0").snapshot()[
+        "schema_version"] == STATS_SCHEMA_VERSION

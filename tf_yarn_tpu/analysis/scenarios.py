@@ -355,6 +355,68 @@ def _registry(tracer: RaceTracer) -> None:
     _phase("race-policy-b", policy_reads)
 
 
+def _fleet_monitor(tracer: RaceTracer) -> None:
+    """FleetMonitor scrape-and-merge vs router-handler aggregate()
+    reads on distinct threads, including the degradation path (a
+    failed scrape falling back to last-good, marked stale). Expected
+    fully clean: every monitor-state access goes through its lock and
+    aggregate() hands out deep copies."""
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet.monitor import FleetMonitor
+    from tf_yarn_tpu.fleet.registry import ReplicaRegistry
+    from tf_yarn_tpu.telemetry.exposition import STATS_SCHEMA_VERSION
+    from tf_yarn_tpu.telemetry.registry import Histogram
+
+    kv = InProcessKV()
+    tasks = ["serving:0", "serving:1"]
+    for index, task in enumerate(tasks):
+        kv.put_str(f"{task}/{event.SERVING_ENDPOINT}",
+                   f"127.0.0.1:{9100 + index}")
+
+    def probe(endpoint):
+        return {"status": "ok", "queue_depth": 0, "active_slots": 1}
+
+    registry = ReplicaRegistry(
+        kv, tasks, probe=probe, probe_interval_s=0.0,
+    )
+
+    down: set = set()
+
+    def scrape(endpoint):
+        if endpoint in down:
+            raise ConnectionError("scrape target down")
+        hist = Histogram()
+        for step in range(1, 4):
+            hist.observe(0.01 * step)
+        return {
+            "schema_version": STATS_SCHEMA_VERSION,
+            "signals": {
+                "version": 1,
+                "histograms": {
+                    "serving/ttft_seconds": hist.to_signal(window=False),
+                },
+                "scalars": {},
+            },
+        }
+
+    monitor = FleetMonitor(
+        registry, scrape=scrape, interval_s=0.0,
+        slo={"ttft_p95_s": 0.5},
+    )
+    tracer.watch(monitor, "monitor")
+
+    _phase("race-refresh", lambda: registry.refresh(force=True))
+    _phase("race-scrape-a", lambda: monitor.poll_once())
+    _phase("race-handler-a", lambda: monitor.aggregate())
+    _phase("race-down", lambda: down.add("127.0.0.1:9100"))
+    _phase("race-scrape-b", lambda: monitor.poll_once())
+    _phase("race-handler-b", lambda: monitor.aggregate())
+    aggregate = monitor.aggregate()
+    if aggregate["status"] != "ok" or not aggregate["stale_replicas"]:
+        raise RuntimeError("scenario never exercised stale degradation")
+
+
 def _metrics_and_spans(tracer: RaceTracer) -> None:
     """A private MetricsRegistry + Tracer under multi-thread increments,
     span recording and flush — expected fully clean (every instrument
@@ -459,6 +521,7 @@ def default_scenarios() -> List[Scenario]:
             ),
         ),
         Scenario("fleet.registry", _registry),
+        Scenario("fleet.monitor", _fleet_monitor),
         Scenario("telemetry.metrics_spans", _metrics_and_spans),
         Scenario("checkpoint.writer", _checkpoint_writer),
     ]

@@ -380,6 +380,13 @@ class ServingExperiment:
     router_policy: str = "least_loaded"
     router_retries: int = 2
     router_probe_interval_s: float = 1.0
+    # Declared service-level objectives (docs/Observability.md "Fleet
+    # observability plane"), e.g. ``{"interactive_ttft_p95_s": 0.5}``:
+    # each replica evaluates them over its recent latency window
+    # (slo/attainment gauges + slo/burn_total counters), and the
+    # router's FleetMonitor evaluates the same objectives fleet-wide
+    # over the merged histograms — the canary-rollback trigger.
+    slo: Optional[Dict[str, float]] = None
 
     def __post_init__(self) -> None:
         if self.max_slots < 1:
@@ -520,6 +527,13 @@ class ServingExperiment:
                 f"router_probe_interval_s must be > 0, got "
                 f"{self.router_probe_interval_s}"
             )
+        if self.slo is not None:
+            from tf_yarn_tpu.telemetry.slo import parse_slo
+
+            try:
+                parse_slo(self.slo)
+            except ValueError as exc:
+                raise ValueError(f"slo: {exc}") from exc
 
 
 @dataclasses.dataclass

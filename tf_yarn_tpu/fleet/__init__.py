@@ -12,6 +12,12 @@ framework-owned placement TF-Replicator argues for (PAPERS.md):
   draining-aware, re-admission on recovery).
 * :mod:`~tf_yarn_tpu.fleet.policy` — balancing policies: round-robin
   and least-loaded (cached ``/healthz`` occupancy + router in-flight).
+* :mod:`~tf_yarn_tpu.fleet.monitor` — the fleet observability plane:
+  a scrape thread that merges per-replica windowed histogram sketches
+  (from each ``/stats`` ``signals`` block) into TRUE pooled fleet
+  quantiles, with last-good/stale degradation and fleet-scope SLO
+  evaluation — the aggregate signal the autoscaler and canary
+  rollback consume.
 * :mod:`~tf_yarn_tpu.fleet.router` — the router HTTP task: the same
   ``/v1/generate`` (streaming passthrough) / ``/healthz`` / ``/stats``
   surface as one replica, with budgeted retry-on-another-replica
@@ -20,6 +26,10 @@ framework-owned placement TF-Replicator argues for (PAPERS.md):
   `topologies.fleet_topology`).
 """
 
+from tf_yarn_tpu.fleet.monitor import (  # noqa: F401
+    FleetMonitor,
+    http_scrape,
+)
 from tf_yarn_tpu.fleet.policy import (  # noqa: F401
     POLICIES,
     LeastLoadedPolicy,
@@ -39,6 +49,7 @@ from tf_yarn_tpu.fleet.router import RouterServer, run_router  # noqa: F401
 
 __all__ = [
     "EJECTED",
+    "FleetMonitor",
     "HEALTHY",
     "LeastLoadedPolicy",
     "PENDING",
@@ -49,6 +60,7 @@ __all__ = [
     "RouterServer",
     "STOPPED",
     "http_probe",
+    "http_scrape",
     "make_policy",
     "run_router",
 ]

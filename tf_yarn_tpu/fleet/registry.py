@@ -108,6 +108,11 @@ class Replica:
     ejections: int = 0
     readmissions: int = 0
     ever_beat: bool = False
+    # /healthz payload schema version; None = a legacy (pre-versioning)
+    # replica that never sent one. Mixed-version fleets keep routing —
+    # the version only informs readers like the monitor, never gates
+    # health.
+    schema_version: Optional[int] = None
 
     @property
     def load(self) -> int:
@@ -125,6 +130,7 @@ class Replica:
             "eject_reason": self.eject_reason,
             "ejections": self.ejections,
             "readmissions": self.readmissions,
+            "schema_version": self.schema_version,
         }
 
 
@@ -287,6 +293,13 @@ class ReplicaRegistry:
             return
         replica.queue_depth = int(payload.get("queue_depth") or 0)
         replica.active_slots = int(payload.get("active_slots") or 0)
+        version = payload.get("schema_version")
+        try:
+            replica.schema_version = (
+                int(version) if version is not None else None
+            )
+        except (TypeError, ValueError):
+            replica.schema_version = None
         status = payload.get("status")
         if status != "ok":
             # "draining" lands here: ejected while the replica is still

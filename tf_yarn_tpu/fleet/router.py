@@ -52,6 +52,7 @@ import json
 import logging
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
@@ -118,6 +119,7 @@ class RouterServer:
         retries: int = 2,
         retry_after_s: float = 1.0,
         upstream_timeout_s: float = 600.0,
+        monitor=None,
     ):
         self.registry = registry
         self.policy = policy if policy is not None else make_policy(
@@ -126,6 +128,11 @@ class RouterServer:
         self.retries = int(retries)
         self.retry_after_s = float(retry_after_s)
         self.upstream_timeout_s = float(upstream_timeout_s)
+        # Optional fleet.FleetMonitor: when attached, its merged
+        # aggregate rides /stats (the autoscaler input) and its
+        # fleet/* gauges ride /metrics. Lifecycle belongs to the
+        # caller (run_router starts/stops it around the serve loop).
+        self.monitor = monitor
         self._metrics = telemetry.get_registry()
         self._routed: Dict[str, Dict[str, int]] = {}
         self._routed_lock = threading.Lock()
@@ -190,13 +197,20 @@ class RouterServer:
                 task: dict(outcomes)
                 for task, outcomes in sorted(self._routed.items())
             }
-        return {
+        out = {
+            "schema_version": telemetry.STATS_SCHEMA_VERSION,
             "role": "router",
             "policy": self.policy.name,
             "retries": self.retries,
             "routed_requests": routed,
             **self.registry.snapshot(),
+            "signals": telemetry.signals_block(
+                prefixes=("fleet/", "slo/", "telemetry/"),
+            ),
         }
+        if self.monitor is not None:
+            out["fleet"] = self.monitor.aggregate()
+        return out
 
 
 def _make_handler(router: RouterServer):
@@ -219,10 +233,13 @@ def _make_handler(router: RouterServer):
             self.wfile.write(body)
 
         def _raw(self, status: int, body: bytes,
-                 content_type: str = "application/json") -> None:
+                 content_type: str = "application/json",
+                 headers=()) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for key, value in headers:
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -248,6 +265,7 @@ def _make_handler(router: RouterServer):
                         replica.kind, 0
                     ) + 1
                 self._json(200, {
+                    "schema_version": telemetry.STATS_SCHEMA_VERSION,
                     "status": "draining" if draining else "ok",
                     "role": "router",
                     "healthy_replicas": len(healthy),
@@ -255,6 +273,14 @@ def _make_handler(router: RouterServer):
                 })
             elif self.path == "/stats":
                 self._json(200, router.stats())
+            elif self.path == "/metrics":
+                body = telemetry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 telemetry.PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -271,15 +297,34 @@ def _make_handler(router: RouterServer):
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
             stream = bool(body.get("stream"))
+            # Cross-task request id: honor a caller-supplied
+            # X-Request-Id, mint one otherwise; forwarded to the
+            # replica so both sides' spans (and the replica's
+            # scheduler trace ring) carry the same id.
+            trace_id = (self.headers.get("X-Request-Id")
+                        or f"req-{uuid.uuid4().hex[:16]}")
+            began = time.monotonic()
+            outcome = "client_dropped"
             try:
-                self._route(raw_body, stream, self.path, kind)
+                with telemetry.span("router/route", request_id=trace_id,
+                                    path=self.path):
+                    outcome = self._route(
+                        raw_body, stream, self.path, kind, trace_id
+                    )
             except (BrokenPipeError, ConnectionResetError):
                 _logger.info("client dropped routed request")
+            finally:
+                # Satellite of the observability plane: the router
+                # times what it routes (it used to only count).
+                router._metrics.histogram(
+                    "fleet/routed_request_seconds",
+                    path=self.path, outcome=outcome,
+                ).observe(time.monotonic() - began)
 
         # -- the routing loop --------------------------------------------
 
         def _route(self, raw_body: bytes, stream: bool,
-                   path: str, kind: str) -> None:
+                   path: str, kind: str, trace_id: str) -> str:
             # Per-request failover budget: connect errors and 429s each
             # consume from their kind's budget; deterministic jitter per
             # request sequence number.
@@ -301,14 +346,14 @@ def _make_handler(router: RouterServer):
                         if router.registry.healthy(kind=kind):
                             continue
                         self._no_replica(busy_hint, last_error, kind)
-                        return
+                        return "no_replica"
                     # Every healthy replica tried this pass: another
                     # round costs one TRANSIENT retry, backing off with
                     # jitter but never below the upstream Retry-After.
                     delay = retry_policy.next_delay(FailureKind.TRANSIENT)
                     if delay is None:
                         self._no_replica(busy_hint, last_error, kind)
-                        return
+                        return "no_replica"
                     time.sleep(
                         min(max(delay, busy_hint), MAX_FAILOVER_SLEEP_S)
                     )
@@ -317,7 +362,7 @@ def _make_handler(router: RouterServer):
                     continue
                 try:
                     outcome = self._forward(
-                        replica, raw_body, stream, path
+                        replica, raw_body, stream, path, trace_id
                     )
                 except _UpstreamUnreachable as exc:
                     router._count(replica.task, "connect_error")
@@ -327,7 +372,7 @@ def _make_handler(router: RouterServer):
                     failure_kind = classify_exception(exc.cause)
                     if retry_policy.next_delay(failure_kind) is None:
                         self._no_replica(busy_hint, last_error, kind)
-                        return
+                        return "no_replica"
                     continue  # fail over immediately: different replica
                 except _UpstreamBusy as exc:
                     router._count(replica.task, "busy")
@@ -340,10 +385,10 @@ def _make_handler(router: RouterServer):
                         FailureKind.TRANSIENT
                     ) is None:
                         self._no_replica(busy_hint, last_error, kind)
-                        return
+                        return "no_replica"
                     continue
                 _logger.debug("routed request: %s", outcome)
-                return
+                return outcome
 
         def _no_replica(self, busy_hint: float, last_error: str,
                         kind: str) -> None:
@@ -365,7 +410,7 @@ def _make_handler(router: RouterServer):
             )
 
         def _forward(self, replica: Replica, raw_body: bytes,
-                     stream: bool, path: str) -> str:
+                     stream: bool, path: str, trace_id: str) -> str:
             host, _, port = (replica.endpoint or "").rpartition(":")
             conn = http.client.HTTPConnection(
                 host, int(port), timeout=router.upstream_timeout_s
@@ -375,7 +420,8 @@ def _make_handler(router: RouterServer):
                 try:
                     conn.request(
                         "POST", path, raw_body,
-                        {"Content-Type": "application/json"},
+                        {"Content-Type": "application/json",
+                         "X-Request-Id": trace_id},
                     )
                     resp = conn.getresponse()
                 except (OSError, http.client.HTTPException) as exc:
@@ -405,6 +451,7 @@ def _make_handler(router: RouterServer):
                         resp.status, payload,
                         resp.getheader("Content-Type")
                         or "application/json",
+                        headers=(("X-Request-Id", trace_id),),
                     )
                     return outcome
                 return self._forward_stream(replica, resp)
@@ -494,6 +541,11 @@ def run_router(experiment, runtime) -> dict:
         probe_interval_s=experiment.router_probe_interval_s,
         dead_heartbeat_s=dead_task_secs_from_env(),
     )
+    from tf_yarn_tpu.fleet.monitor import FleetMonitor
+
+    monitor = FleetMonitor(
+        registry, slo=getattr(experiment, "slo", None),
+    )
     server = RouterServer(
         registry,
         make_policy(experiment.router_policy),
@@ -501,7 +553,9 @@ def run_router(experiment, runtime) -> dict:
         experiment.router_port,
         retries=experiment.router_retries,
         retry_after_s=experiment.retry_after_s,
+        monitor=monitor,
     )
+    monitor.start()
     endpoint = server.start()
     advertised = advertised_endpoint(experiment.router_host, server.port)
     event.router_endpoint_event(runtime.kv, runtime.task, advertised)
@@ -528,6 +582,7 @@ def run_router(experiment, runtime) -> dict:
             registry.refresh()
             time.sleep(POLL_S)
     finally:
+        monitor.stop()
         server.stop()
         stats = {"endpoint": advertised, **server.stats()}
         _logger.info("router done: %s", stats)

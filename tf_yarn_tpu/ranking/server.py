@@ -111,12 +111,28 @@ def _make_handler(scheduler: MicroBatchScheduler):
                     snap.get("draining")
                 ) or preemption.requested()
                 self._json(200, {
+                    "schema_version": telemetry.STATS_SCHEMA_VERSION,
                     "status": "draining" if draining else "ok",
                     "queue_depth": snap["queue_depth"],
                     "queued_rows": snap["queued_rows"],
                 })
             elif self.path == "/stats":
-                self._json(200, scheduler.stats())
+                self._json(200, {
+                    "schema_version": telemetry.STATS_SCHEMA_VERSION,
+                    **scheduler.stats(),
+                    "signals": telemetry.signals_block(
+                        prefixes=("ranking/", "rank_engine/",
+                                  "slo/", "telemetry/"),
+                    ),
+                })
+            elif self.path == "/metrics":
+                body = telemetry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 telemetry.PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -134,10 +150,12 @@ def _make_handler(scheduler: MicroBatchScheduler):
             except (KeyError, TypeError, ValueError) as exc:
                 self._json(400, {"error": f"bad request: {exc}"})
                 return
+            trace_id = self.headers.get("X-Request-Id") or None
             try:
-                response = scheduler.submit(
-                    cat, dense, priority=priority, timeout_s=timeout_s
-                )
+                with telemetry.span("ranking/submit", request_id=trace_id):
+                    response = scheduler.submit(
+                        cat, dense, priority=priority, timeout_s=timeout_s
+                    )
             except QueueFull as exc:
                 self._json(
                     429,
@@ -161,7 +179,9 @@ def _make_handler(scheduler: MicroBatchScheduler):
                 "scores": scores,
                 "finish_reason": response.finish_reason,
                 "request_id": response.request.id,
-            })
+            }, headers=(
+                (("X-Request-Id", trace_id),) if trace_id else ()
+            ))
 
     return Handler
 

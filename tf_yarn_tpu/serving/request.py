@@ -108,6 +108,10 @@ class Request:
     tier: str = DEFAULT_TIER
     id: int = dataclasses.field(default_factory=lambda: next(_REQUEST_IDS))
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # Cross-task trace id (the router's X-Request-Id): joins this
+    # request's scheduler trace-ring entries and spans to the router's
+    # span for the same HTTP request. None for untraced callers.
+    trace_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         self.prompt = tuple(int(t) for t in self.prompt)

@@ -371,6 +371,13 @@ class SlotScheduler:
         self._free: Deque[int] = collections.deque(range(max_slots))
         self._used_before = [False] * max_slots
         self.trace: Deque[Dict] = collections.deque(maxlen=trace_len)
+        # request.id -> cross-task trace id (the router's X-Request-Id)
+        # for requests still in flight; written by submit() on any
+        # thread, read by the tick when stamping trace-ring entries,
+        # pruned at retirement. Own lock: submit() must not contend on
+        # tick-internal state.
+        self._trace_ids: Dict[int, str] = {}
+        self._trace_id_lock = threading.Lock()
         self._ticks = 0
         self._draining = False
         self._work = threading.Event()
@@ -468,11 +475,14 @@ class SlotScheduler:
         priority: int = 0,
         timeout_s: Optional[float] = None,
         tier: str = DEFAULT_TIER,
+        trace_id: Optional[str] = None,
     ) -> Response:
         """Admit one request; returns its streaming Response. Raises
         ValueError for requests this grid cannot serve (an unknown
         `tier` included) and QueueFull when the bounded queue — or the
-        request's tier cap — is at capacity (backpressure)."""
+        request's tier cap — is at capacity (backpressure). `trace_id`
+        (the router's X-Request-Id) tags this request's trace-ring
+        entries so one id joins router span → queue wait → ticks."""
         params = params or SamplingParams(
             temperature=self.temperature, top_k=self.top_k, top_p=self.top_p
         )
@@ -488,7 +498,7 @@ class SlotScheduler:
             )
         request = Request(
             prompt=tuple(prompt), params=params, priority=priority,
-            timeout_s=timeout_s, tier=tier,
+            timeout_s=timeout_s, tier=tier, trace_id=trace_id,
         )
         limit = self.context_limit
         if limit is not None and (
@@ -528,6 +538,9 @@ class SlotScheduler:
         except Exception:
             self._registry.counter("serving/requests_rejected_total").inc()
             raise
+        if trace_id is not None:
+            with self._trace_id_lock:
+                self._trace_ids[request.id] = trace_id
         self._registry.counter("serving/requests_total").inc()
         self._registry.gauge("serving/queue_depth").set(self.queue.depth)
         self._work.set()
@@ -585,6 +598,21 @@ class SlotScheduler:
                 # Tokens emitted per request this tick (1 = the exact
                 # step's pace; > 1 = accepted drafts landed).
                 entry["accepted"] = accepts
+            touched = set(admitted)
+            touched.update(rid for rid, _ in retired)
+            if touched:
+                with self._trace_id_lock:
+                    trace_map = {
+                        rid: self._trace_ids[rid]
+                        for rid in touched if rid in self._trace_ids
+                    }
+                    for rid, _ in retired:
+                        self._trace_ids.pop(rid, None)
+                if trace_map:
+                    # Cross-task join: request.id -> the router's
+                    # X-Request-Id, for every request admitted or
+                    # retired this tick.
+                    entry["trace"] = trace_map
             self.trace.append(entry)
         self._registry.gauge("serving/active_slots").set(
             len([s for s in self._slots if s is not None])
@@ -1045,9 +1073,7 @@ class SlotScheduler:
             first = state.response.first_token_at is None
             state.response._push(token)
             if first:
-                self._registry.histogram("serving/ttft_seconds").observe(
-                    state.response.ttft_s
-                )
+                self._observe_ttft(state)
             elif state.last_emit_at is not None:
                 self._registry.histogram(
                     "serving/inter_token_latency_ms"
@@ -1060,6 +1086,16 @@ class SlotScheduler:
             elif state.emitted >= state.request.params.max_new_tokens:
                 self._retire(slot, FINISH_LENGTH, retired)
         self._account_tokens(prefill_tokens, decode_tokens)
+
+    def _observe_ttft(self, state) -> None:
+        # The unlabeled histogram is the back-compat aggregate; the
+        # tier-labeled one feeds per-tier SLO objectives (e.g.
+        # interactive_ttft_p95_s) without touching existing keys.
+        ttft = state.response.ttft_s
+        self._registry.histogram("serving/ttft_seconds").observe(ttft)
+        self._registry.histogram(
+            "serving/ttft_seconds", tier=state.request.tier
+        ).observe(ttft)
 
     def _step_spec(self, active: List[int], retired: List) -> Dict[int, int]:
         """The windowed tick: ONE compiled program advances every slot a
@@ -1177,9 +1213,7 @@ class SlotScheduler:
                 first = state.response.first_token_at is None
                 state.response._push(token)
                 if first:
-                    self._registry.histogram(
-                        "serving/ttft_seconds"
-                    ).observe(state.response.ttft_s)
+                    self._observe_ttft(state)
                 elif state.last_emit_at is not None:
                     # Tokens landing in the same tick (accepted drafts)
                     # record a ~0 gap — they really do arrive together.

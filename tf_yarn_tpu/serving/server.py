@@ -54,13 +54,14 @@ class ServingServer:
     client never blocks admissions."""
 
     def __init__(self, scheduler: SlotScheduler, host: str = "127.0.0.1",
-                 port: int = 0):
-        handler = _make_handler(scheduler)
+                 port: int = 0, *, slo_evaluator=None):
+        handler = _make_handler(scheduler, slo_evaluator)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.scheduler = scheduler
+        self.slo_evaluator = slo_evaluator
 
     @property
     def port(self) -> int:
@@ -94,7 +95,7 @@ class ServingServer:
             thread.join(timeout=10.0)
 
 
-def _make_handler(scheduler: SlotScheduler):
+def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -137,12 +138,30 @@ def _make_handler(scheduler: SlotScheduler):
                     snap.get("draining")
                 ) or preemption.requested()
                 self._json(200, {
+                    "schema_version": telemetry.STATS_SCHEMA_VERSION,
                     "status": "draining" if draining else "ok",
                     "active_slots": snap["active_slots"],
                     "queue_depth": snap["queue_depth"],
                 })
             elif self.path == "/stats":
-                self._json(200, scheduler.stats())
+                payload = {
+                    "schema_version": telemetry.STATS_SCHEMA_VERSION,
+                    **scheduler.stats(),
+                    "signals": telemetry.signals_block(
+                        prefixes=("serving/", "slo/", "telemetry/"),
+                    ),
+                }
+                if slo_evaluator is not None:
+                    payload["slo"] = slo_evaluator.report()
+                self._json(200, payload)
+            elif self.path == "/metrics":
+                body = telemetry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 telemetry.PROMETHEUS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -185,13 +204,22 @@ def _make_handler(scheduler: SlotScheduler):
                 })
                 return
             timeout_s = body.get("timeout_s")
+            # Cross-task tracing: the router (or any caller) supplies
+            # X-Request-Id; it tags this replica's submit span and the
+            # scheduler's trace-ring entries, and echoes back.
+            trace_id = self.headers.get("X-Request-Id") or None
             try:
-                response = scheduler.submit(
-                    prompt, params,
-                    priority=int(body.get("priority", 0)),
-                    timeout_s=timeout_s,
-                    tier=str(body.get("tier", DEFAULT_TIER)),
-                )
+                with telemetry.span(
+                    "serving/submit", request_id=trace_id,
+                    prompt_tokens=len(prompt),
+                ):
+                    response = scheduler.submit(
+                        prompt, params,
+                        priority=int(body.get("priority", 0)),
+                        timeout_s=timeout_s,
+                        tier=str(body.get("tier", DEFAULT_TIER)),
+                        trace_id=trace_id,
+                    )
             except QueueFull as exc:
                 # Backpressure crosses the wire as a 429 + Retry-After:
                 # the client sheds or retries, the server never buffers
@@ -211,6 +239,8 @@ def _make_handler(scheduler: SlotScheduler):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")
+                if trace_id:
+                    self.send_header("X-Request-Id", trace_id)
                 self.end_headers()
                 try:
                     for token in response.tokens():
@@ -244,7 +274,9 @@ def _make_handler(scheduler: SlotScheduler):
                 "finish_reason": response.finish_reason,
                 "request_id": response.request.id,
                 "ttft_s": response.ttft_s,
-            })
+            }, headers=(
+                (("X-Request-Id", trace_id),) if trace_id else ()
+            ))
 
     return Handler
 
@@ -334,7 +366,15 @@ def run_serving(experiment, runtime=None) -> dict:
         kv_host_blocks=experiment.kv_host_blocks,
         tier_caps=experiment.tier_caps,
     )
-    server = ServingServer(scheduler, experiment.host, experiment.port)
+    slo_evaluator = None
+    if getattr(experiment, "slo", None):
+        slo_evaluator = telemetry.SloEvaluator(
+            telemetry.parse_slo(experiment.slo)
+        )
+    server = ServingServer(
+        scheduler, experiment.host, experiment.port,
+        slo_evaluator=slo_evaluator,
+    )
     scheduler.start()
     endpoint = server.start()
     advertised = advertised_endpoint(experiment.host, server.port)
@@ -364,6 +404,8 @@ def run_serving(experiment, runtime=None) -> dict:
                     experiment.serve_seconds,
                 )
                 break
+            if slo_evaluator is not None:
+                slo_evaluator.maybe_evaluate()
             time.sleep(0.2)
     finally:
         server.stop()

@@ -12,19 +12,39 @@ One subsystem behind every observability surface in the framework
   flushed to the log, MLflow, and the coordination KV store.
 * :mod:`~tf_yarn_tpu.telemetry.heartbeat` — per-task liveness gauges
   over KV, so stragglers are visible from the chief.
+* :mod:`~tf_yarn_tpu.telemetry.exposition` — Prometheus text rendering
+  for `/metrics` plus the versioned `signals` block `/stats` embeds
+  (windowed histogram bucket sketches the fleet monitor merges into
+  pooled quantiles).
+* :mod:`~tf_yarn_tpu.telemetry.slo` — declared latency objectives
+  evaluated over histogram windows into ``slo/attainment`` gauges and
+  ``slo/burn_total`` counters.
 
 Everything is host-side: no instrument or span may live inside a jit
 body (the analysis checker gates the instrumented call sites in CI).
 """
 
+from tf_yarn_tpu.telemetry.exposition import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
+    SIGNALS_VERSION,
+    STATS_SCHEMA_VERSION,
+    render_prometheus,
+    signals_block,
+)
 from tf_yarn_tpu.telemetry.heartbeat import Heartbeat  # noqa: F401
 from tf_yarn_tpu.telemetry.registry import (  # noqa: F401
     Counter,
     Gauge,
+    HIST_ALPHA,
     Histogram,
     MetricsRegistry,
     flush_metrics,
     get_registry,
+)
+from tf_yarn_tpu.telemetry.slo import (  # noqa: F401
+    SloEvaluator,
+    SloObjective,
+    parse_slo,
 )
 from tf_yarn_tpu.telemetry.spans import (  # noqa: F401
     Span,
@@ -42,9 +62,15 @@ from tf_yarn_tpu.telemetry.spans import (  # noqa: F401
 __all__ = [
     "Counter",
     "Gauge",
+    "HIST_ALPHA",
     "Heartbeat",
     "Histogram",
     "MetricsRegistry",
+    "PROMETHEUS_CONTENT_TYPE",
+    "SIGNALS_VERSION",
+    "STATS_SCHEMA_VERSION",
+    "SloEvaluator",
+    "SloObjective",
     "Span",
     "TRACE_ENV",
     "TRACE_JSONL_ENV",
@@ -55,6 +81,9 @@ __all__ = [
     "flush_metrics",
     "get_registry",
     "get_tracer",
+    "parse_slo",
+    "render_prometheus",
+    "signals_block",
     "span",
     "trace_dir",
 ]

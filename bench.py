@@ -446,6 +446,22 @@ def _stale_tpu_fields() -> dict:
     for key, value in fleet.items():
         if str(key).startswith("scaling_"):
             fields[f"last_tpu_fleet_{key}"] = value
+    # Elastic A/B (autoscaler vs static fleet): violation rates per
+    # arm, the delta, and the bit-identity flag. CPU reruns never
+    # overwrite these — the TPU row is the capacity claim; a CPU rig's
+    # rows are scheduling evidence only (the section's note says so).
+    autoscale_ab = fleet.get("autoscale") or {}
+    for row_name, row in (autoscale_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "slo_violation_rate" in row:
+            fields[
+                f"last_tpu_fleet_autoscale_{row_name}_slo_violation_rate"
+            ] = row["slo_violation_rate"]
+            fields[f"last_tpu_fleet_autoscale_{row_name}_ttft_p95_ms"] = (
+                row.get("ttft_p95_ms")
+            )
+    for key in ("violation_delta", "streams_match"):
+        if key in autoscale_ab:
+            fields[f"last_tpu_fleet_autoscale_{key}"] = autoscale_ab[key]
     rank = table.get("rank") or {}
     for row_name, row in (rank.get("rows") or {}).items():
         if isinstance(row, dict) and "requests_per_sec" in row:
@@ -816,6 +832,34 @@ def bench_flagship_train():
             _log(f"fleet: {fleet}")
         except Exception as exc:
             _log(f"fleet bench FAILED: {type(exc).__name__}: {exc}")
+        try:
+            # Elastic A/B (ROADMAP item 1's autoscaler): static fleet
+            # vs autoscaled fleet under the same seeded rate-step trace
+            # with one injected preemption + relaunch. Headline: the
+            # SLO-violation delta and the bit-identity flag.
+            fleet_as = suite.bench_fleet(tpu=True, autoscale=True)
+            ab.setdefault("fleet", {})["autoscale"] = fleet_as
+            _write_ab(ab)
+            for row_name, row in (fleet_as.get("rows") or {}).items():
+                if isinstance(row, dict) and "slo_violation_rate" in row:
+                    result[
+                        f"fleet_autoscale_{row_name}_slo_violation_rate"
+                    ] = row["slo_violation_rate"]
+                    result[f"fleet_autoscale_{row_name}_ttft_p95_ms"] = (
+                        row.get("ttft_p95_ms")
+                    )
+            auto_row = (fleet_as.get("rows") or {}).get("autoscaled") or {}
+            for key in ("scale_events", "warm_start_pulls", "warm_starts",
+                        "warm_start_blocks"):
+                if key in auto_row:
+                    result[f"fleet_autoscale_{key}"] = auto_row[key]
+            for key in ("violation_delta", "streams_match"):
+                if key in fleet_as:
+                    result[f"fleet_autoscale_{key}"] = fleet_as[key]
+            _log(f"fleet autoscale: {fleet_as}")
+        except Exception as exc:
+            _log(f"fleet autoscale bench FAILED: "
+                 f"{type(exc).__name__}: {exc}")
         try:
             rank = suite.bench_rank(tpu=True)
             ab["rank"] = rank

@@ -1382,7 +1382,8 @@ def bench_serve(tpu: bool, tp: bool = False, chunked: bool = False,
     return out
 
 
-def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
+def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None,
+                autoscale=False):
     """Fleet mode of the serve bench: aggregate tokens/s and TTFT p95
     vs replica count under the SAME seeded Poisson arrival trace,
     driven end-to-end through the fleet ROUTER (tf_yarn_tpu/fleet/):
@@ -1392,7 +1393,20 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
     TTFT is measured client-side at first token line, so discovery,
     balancing, and the extra hop are all inside the number. The decode
     engine (and its compiled programs) is shared across replicas, so
-    the sweep measures the replica axis, not recompilation."""
+    the sweep measures the replica axis, not recompilation.
+
+    ``autoscale=True`` (`fleet --autoscale`) switches to the elastic
+    A/B instead of the replica sweep: a STATIC 2-replica fleet vs an
+    AUTOSCALED one (start 2, max 4, FleetAutoscaler side-car with an
+    in-process spawn actuator + real /v1/blocks peer warm start) under
+    the SAME seeded Poisson trace with a mid-run rate step, plus one
+    injected replica preemption (eject + relaunch on a NEW port, the
+    registry re-admit path) in BOTH arms. Reported: per-arm
+    SLO-violation rate (client-side TTFT over the threshold), dropped
+    in-flight streams (must be 0), and ``streams_match`` — the two
+    arms' per-request token sequences compared bit-for-bit (scaling
+    must change WHEN tokens arrive, never WHICH). On the CPU rig the
+    latency numbers are scheduling evidence only."""
     import threading
     import time
 
@@ -1427,7 +1441,6 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
         config = TransformerConfig.tiny(scan_layers=False, max_seq_len=64)
         default_requests, max_slots, mean_gap_s = 12, 4, 0.005
         prompt_lens, max_new_range = (5, 9, 14), (2, 16)
-    n_requests = n_requests or default_requests
     model = Transformer(config)
     rng = np.random.RandomState(0)
     params = nn.meta.unbox(
@@ -1437,6 +1450,10 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
         )
     )
     engine = DecodeEngine(model)
+    if autoscale:
+        return _bench_fleet_autoscale(
+            tpu, engine, params, config, max_slots, n_requests)
+    n_requests = n_requests or default_requests
 
     # The bench_serve seeded Poisson trace, shared by every fleet size.
     gaps = rng.exponential(mean_gap_s, n_requests)
@@ -1610,6 +1627,360 @@ def bench_fleet(tpu: bool, replica_counts=(1, 2, 4), n_requests=None):
             result[f"scaling_r{count}_vs_r{replica_counts[0]}"] = round(
                 top / base, 3
             )
+    return result
+
+
+def _bench_fleet_autoscale(tpu, engine, params, config, max_slots,
+                           n_requests=None):
+    """`fleet --autoscale`: the elastic A/B (see bench_fleet's
+    docstring). Static 2-replica arm vs autoscaled arm (start 2, max 4)
+    under one seeded rate-step Poisson trace with one injected replica
+    preemption + relaunch-on-a-new-port in both arms."""
+    import sys
+    import threading
+    import time
+
+    import numpy as np
+
+    from tf_yarn_tpu import event, telemetry
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet import (
+        AutoscalePolicy,
+        FleetAutoscaler,
+        FleetMonitor,
+        ReplicaRegistry,
+        RouterServer,
+        make_policy,
+    )
+    from tf_yarn_tpu.serving import SamplingParams, ServingServer, SlotScheduler
+
+    rng = np.random.RandomState(7)
+    if tpu:
+        n_requests = n_requests or 48
+        mean_gap_s, step_factor = 0.05, 4.0
+        block_size, prefix_len = 16, 64
+        tail_lens, max_new_range = (32, 64, 96), (16, 96)
+        slo_ttft_s, interval_s = 0.5, 0.1
+        ab_slots = max_slots
+    else:
+        n_requests = n_requests or 24
+        mean_gap_s, step_factor = 0.04, 4.0
+        block_size, prefix_len = 8, 16
+        tail_lens, max_new_range = (3, 5, 8), (2, 10)
+        slo_ttft_s, interval_s = 0.5, 0.05
+        # Few slots per replica so TTFT is queue-wait dominated: extra
+        # replicas add admission capacity even on a GIL-shared CPU rig.
+        ab_slots = min(4, max_slots)
+
+    # ONE seeded trace for both arms: Poisson at the base rate for the
+    # first half, then the gaps compress by step_factor (the demand
+    # surge the autoscaled arm should absorb). Every prompt opens with
+    # a shared prefix so the prefix cache — and the peer warm start
+    # that ships it — has something to hit.
+    gaps = rng.exponential(mean_gap_s, n_requests)
+    gaps[n_requests // 2:] /= step_factor
+    arrivals = np.cumsum(gaps)
+    shared_prefix = rng.randint(0, config.vocab_size, prefix_len).tolist()
+    requests = [
+        (
+            float(arrivals[i]),
+            shared_prefix + rng.randint(
+                0, config.vocab_size, rng.choice(tail_lens)).tolist(),
+            int(rng.randint(*max_new_range)),
+        )
+        for i in range(n_requests)
+    ]
+    kill_at = float(arrivals[n_requests // 3])
+
+    def stream_ab(port, offset, prompt, max_new, t0, out, index):
+        import http.client
+        import json as json_lib
+
+        lag = t0 + offset - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+        try:
+            conn.request(
+                "POST", "/v1/generate",
+                json_lib.dumps({"prompt": prompt,
+                                "max_new_tokens": max_new,
+                                "stream": True}),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            first = None
+            tokens = []
+            dropped = resp.status != 200
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                payload = json_lib.loads(line)
+                if "token" in payload:
+                    if first is None:
+                        first = time.perf_counter()
+                    tokens.append(int(payload["token"]))
+                if payload.get("error"):
+                    dropped = True
+            out.append({
+                "index": index,
+                "status": resp.status,
+                "tokens": tokens,
+                "dropped": dropped,
+                "ttft_s": (first - (t0 + offset))
+                if first is not None else None,
+            })
+        except Exception as exc:  # noqa: BLE001 - record, keep benching
+            out.append({"index": index, "status": 0, "tokens": [],
+                        "dropped": True, "ttft_s": None,
+                        "error": f"{type(exc).__name__}: {exc}"[:120]})
+        finally:
+            conn.close()
+
+    def run_arm(autoscaled):
+        telemetry.get_registry().clear()
+        kv = InProcessKV()
+        state_lock = threading.Lock()
+        replicas = []
+        next_id = [0]
+
+        def spawn_replica(task=None):
+            with state_lock:
+                if task is None:
+                    task = f"serving:{next_id[0]}"
+                next_id[0] = max(next_id[0],
+                                 int(task.split(":", 1)[1]) + 1)
+            scheduler = SlotScheduler(
+                engine, params, max_slots=ab_slots,
+                queue_capacity=max(64, n_requests),
+                kv_layout="paged", block_size=block_size,
+                prefix_cache_capacity=64,
+            )
+            scheduler.start()
+            server = ServingServer(scheduler, "127.0.0.1", 0)
+            server.start()
+            # Advertise AFTER the server listens: the registry probes
+            # the advertised address on its next refresh pass.
+            event.serving_endpoint_event(kv, task, server.endpoint)
+            with state_lock:
+                replicas.append((task, scheduler, server))
+            return task
+
+        for _ in range(2):
+            spawn_replica()
+        registry = ReplicaRegistry(
+            kv, tasks=None, probe_interval_s=interval_s / 2,
+        )
+        registry.refresh(force=True)
+        monitor = FleetMonitor(registry, interval_s=interval_s)
+        autoscaler = None
+        if autoscaled:
+            def actuate(kind, current, target, reason):
+                if kind != "generate" or target <= current:
+                    return False
+                # Idempotent against the registry's lag: `current` is
+                # the fleet the registry can SEE, which trails replicas
+                # still constructing — spawn toward the target from the
+                # count of distinct tasks ever launched, not by delta.
+                with state_lock:
+                    missing = target - next_id[0]
+                if missing <= 0:
+                    return False
+                # Launch off-thread: real relaunches take seconds and
+                # the decision loop must not block on them.
+                threading.Thread(
+                    target=lambda: [spawn_replica()
+                                    for _ in range(missing)],
+                    name="bench-scale-out", daemon=True,
+                ).start()
+                return True
+
+            autoscaler = FleetAutoscaler(
+                registry, monitor,
+                {"generate": AutoscalePolicy(
+                    min_replicas=2, max_replicas=4,
+                    scale_out_queue_depth=0.5,
+                    scale_out_p95_s=slo_ttft_s,
+                    scale_in_load=None, cooldown_cycles=2,
+                )},
+                actuate=actuate, interval_s=interval_s,
+            )
+        router = RouterServer(
+            registry, make_policy("least_loaded"), "127.0.0.1", 0,
+            retries=2, monitor=monitor, autoscaler=autoscaler,
+        )
+        router.start()
+        monitor.start()
+        stop = threading.Event()
+
+        def refresh_loop():
+            while not stop.is_set():
+                registry.refresh()
+                stop.wait(interval_s / 2)
+
+        refresher = threading.Thread(
+            target=refresh_loop, name="bench-registry-refresh",
+            daemon=True,
+        )
+        refresher.start()
+        try:
+            # Compile every prompt bucket outside the timed window
+            # (shared engine: paid once across both arms).
+            for tail in tail_lens:
+                replicas[0][1].submit(
+                    shared_prefix + [1] * tail,
+                    SamplingParams(max_new_tokens=2),
+                ).result(timeout=600)
+            results = []
+            threads = []
+            t0 = time.perf_counter()
+
+            def chaos_kill():
+                lag = t0 + kill_at - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                with state_lock:
+                    victim = replicas[0][0]
+                registry.report_failure(
+                    victim, ConnectionError("preempted (bench chaos)"),
+                )
+                # Relaunch under the SAME task name on a NEW port: the
+                # registry re-admit path probes the fresh address, and
+                # the autoscaled arm warm-starts the cold cache from a
+                # live peer over /v1/blocks. In-flight streams on the
+                # old server drain to completion (zero dropped).
+                spawn_replica(task=victim)
+
+            killer = threading.Thread(
+                target=chaos_kill, name="bench-chaos", daemon=True,
+            )
+            killer.start()
+            for index, (offset, prompt, max_new) in enumerate(requests):
+                thread = threading.Thread(
+                    target=stream_ab,
+                    args=(router.port, offset, prompt, max_new, t0,
+                          results, index),
+                )
+                thread.start()
+                threads.append(thread)
+            # The main thread paces the autoscaler for the trace's
+            # duration: production runs autoscaler.start()'s side-car
+            # thread, but under a saturated bench GIL a side-car gets
+            # starved to a couple of cycles — polling from the load
+            # generator's clock keeps the decision cadence honest in
+            # both arms' measurement windows.
+            deadline = time.perf_counter() + 900
+            while any(t.is_alive() for t in threads):
+                if time.perf_counter() > deadline:
+                    break
+                if autoscaler is not None:
+                    try:
+                        autoscaler.poll_once()
+                    except Exception:  # noqa: BLE001 - cycle, not arm
+                        pass
+                time.sleep(interval_s)
+            for thread in threads:
+                thread.join(timeout=60)
+            killer.join(timeout=60)
+            # Ingest any relaunch/scale-out that advertised after the
+            # refresher's last pass, then run a final decision cycle: a
+            # re-admission that landed after the last in-trace poll
+            # still warm-starts (the endpoint-change trigger is
+            # stateful, not edge-sampled).
+            registry.refresh(force=True)
+            if autoscaler is not None:
+                autoscaler.poll_once()
+            wall = time.perf_counter() - t0
+            violated = sum(
+                1 for r in results
+                if r["dropped"] or r["ttft_s"] is None
+                or r["ttft_s"] > slo_ttft_s
+            )
+            ttfts = sorted(
+                r["ttft_s"] for r in results if r["ttft_s"] is not None
+            )
+            row = {
+                "completed": sum(1 for r in results if not r["dropped"]),
+                "dropped": sum(1 for r in results if r["dropped"]),
+                "wall_s": round(wall, 3),
+                "slo_violation_rate": round(
+                    violated / max(1, len(results)), 3),
+            }
+            if ttfts:
+                row["ttft_p95_ms"] = round(
+                    1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 2)
+            snapshot = registry.snapshot()
+            row["replicas_final"] = snapshot["healthy_replicas"]
+            row["readmissions"] = snapshot["readmissions_total"]
+            if autoscaler is not None:
+                stats = autoscaler.stats()
+                row["autoscaler_cycles"] = stats["cycles"]
+                row["scale_events"] = len(stats["scale_events"])
+                # pulls = attempts; warm_starts = pulls that shipped
+                # blocks (a pull that finds the peer already re-heated
+                # organically imports 0 — the fleet healed either way).
+                row["warm_start_pulls"] = len(stats["warm_starts"])
+                row["warm_starts"] = sum(
+                    1 for w in stats["warm_starts"]
+                    if w.get("imported_blocks")
+                )
+                row["warm_start_blocks"] = int(
+                    telemetry.get_registry().counter(
+                        "fleet/warm_start_blocks_total").value
+                )
+            streams = {r["index"]: list(r["tokens"]) for r in results}
+            return row, streams
+        finally:
+            stop.set()
+            if autoscaler is not None:
+                autoscaler.stop()
+            monitor.stop()
+            router.stop()
+            refresher.join(timeout=10)
+            with state_lock:
+                final = list(replicas)
+            for _task, scheduler, server in final:
+                server.stop()
+                scheduler.close()
+
+    rows = {}
+    streams = {}
+    switch_interval = sys.getswitchinterval()
+    sys.setswitchinterval(0.002)  # fairer GIL handoff under 24+ threads
+    try:
+        for arm, autoscaled in (("static", False), ("autoscaled", True)):
+            try:
+                rows[arm], streams[arm] = run_arm(autoscaled)
+            except Exception as exc:  # noqa: BLE001 - record, keep benching
+                rows[arm] = {"error": f"{type(exc).__name__}: {exc}"[:160]}
+    finally:
+        sys.setswitchinterval(switch_interval)
+    result = {
+        "mode": "autoscale_ab",
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "slo_ttft_s": slo_ttft_s,
+        "rate_step_factor": step_factor,
+        "kill_at_s": round(kill_at, 3),
+        "rows": rows,
+    }
+    if len(streams) == 2:
+        # Scaling must change WHEN tokens arrive, never WHICH: the two
+        # arms' per-request token sequences must be bit-identical.
+        result["streams_match"] = streams["static"] == streams["autoscaled"]
+    static_row, auto_row = rows.get("static", {}), rows.get("autoscaled", {})
+    if "slo_violation_rate" in static_row \
+            and "slo_violation_rate" in auto_row:
+        result["violation_delta"] = round(
+            static_row["slo_violation_rate"]
+            - auto_row["slo_violation_rate"], 3,
+        )
+    if not tpu:
+        result["note"] = (
+            "CPU rig: latency rows are scheduling evidence only; the "
+            "TPU row is the capacity claim"
+        )
     return result
 
 
@@ -1838,6 +2209,15 @@ def main() -> None:
         ),
     )
     parser.add_argument(
+        "--autoscale", action="store_true",
+        help=(
+            "fleet config: run the static-vs-autoscaled elastic A/B "
+            "(rate-step trace + injected replica preemption, "
+            "SLO-violation rate + streams_match) instead of the "
+            "replica sweep"
+        ),
+    )
+    parser.add_argument(
         "--overload", action="store_true",
         help=(
             "serve config: add the hold-until-free vs suspend-to-host "
@@ -1869,6 +2249,8 @@ def main() -> None:
                 tpu, tp=args.tp, chunked=args.chunked,
                 overload=args.overload,
             )
+        elif name == "fleet":
+            result = CONFIGS[name](tpu, autoscale=args.autoscale)
         else:
             result = CONFIGS[name](tpu)
         print(json.dumps({"config": name, "tpu": tpu, **{

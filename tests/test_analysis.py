@@ -33,7 +33,7 @@ CONC_STATIC_RULES = ["TYA301", "TYA302", "TYA303"]
 SCENARIO_NAMES = {
     "serving.slot_scheduler", "serving.suspend_resume",
     "ranking.micro_batch", "fleet.registry", "fleet.monitor",
-    "telemetry.metrics_spans", "checkpoint.writer",
+    "fleet.autoscaler", "telemetry.metrics_spans", "checkpoint.writer",
 }
 
 

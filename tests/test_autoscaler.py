@@ -1,0 +1,451 @@
+"""Fleet autoscaler: the elastic serving decision plane.
+
+The autoscaler's inputs are two read-only views — the registry snapshot
+and the monitor aggregate — so every decision path is driven here with
+stub views and asserted deterministically: trigger selection (queue
+depth, latency p95, SLO burn), the cooldown refractory period, the
+below-min self-healing floor, scale-in, actuator failure handling, and
+the peer warm-start bookkeeping (endpoint change = cold cache; veteran
+peers donate; a same-endpoint readmission is left alone). The live
+wiring — real registry, real monitor, real HTTP — is held by the e2e
+test in test_warm_start.py and the `fleet.autoscaler` lockset scenario.
+"""
+
+import pytest
+
+from tf_yarn_tpu import telemetry
+from tf_yarn_tpu.fleet.autoscaler import (
+    LAUNCH_ETA_CEILING_S,
+    LAUNCH_ETA_FLOOR_S,
+    AutoscalePolicy,
+    FleetAutoscaler,
+    clamp_launch_eta,
+    parse_autoscale,
+)
+
+
+class StubFleet:
+    """The registry contract the autoscaler reads: `snapshot()`."""
+
+    def __init__(self):
+        self.replicas = {}
+
+    def set(self, task, *, kind="generate", state="healthy",
+            endpoint=None, queue_depth=0, active_slots=0, inflight=0,
+            readmissions=0):
+        self.replicas[task] = {
+            "task": task,
+            "kind": kind,
+            "state": state,
+            "endpoint": endpoint or f"127.0.0.1:9{task.split(':')[1]}00",
+            "queue_depth": queue_depth,
+            "active_slots": active_slots,
+            "inflight": inflight,
+            "readmissions": readmissions,
+        }
+
+    def snapshot(self):
+        return {"replicas": {t: dict(r) for t, r in self.replicas.items()}}
+
+
+class StubMonitor:
+    """The monitor contract: `aggregate()` with histograms + slo."""
+
+    def __init__(self):
+        self.histograms = {}
+        self.slo = {}
+
+    def aggregate(self):
+        return {"histograms": dict(self.histograms),
+                "slo": dict(self.slo)}
+
+
+def _autoscaler(policies, fleet=None, monitor=None, **kwargs):
+    telemetry.get_registry().clear()
+    actuations = []
+    kwargs.setdefault(
+        "actuate",
+        lambda kind, cur, tgt, reason: actuations.append(
+            (kind, cur, tgt, reason)) or True,
+    )
+    kwargs.setdefault("fetch_blocks", lambda endpoint: b"{}")
+    kwargs.setdefault(
+        "push_blocks",
+        lambda endpoint, body: {"imported_blocks": 2,
+                                "registered_entries": 1},
+    )
+    autoscaler = FleetAutoscaler(
+        fleet if fleet is not None else StubFleet(),
+        monitor,
+        policies,
+        **kwargs,
+    )
+    return autoscaler, actuations
+
+
+# --------------------------------------------------------------------------
+# knob validation
+# --------------------------------------------------------------------------
+
+def test_parse_autoscale_validates_kinds_and_fields():
+    parsed = parse_autoscale({
+        "generate": {"min_replicas": 1, "max_replicas": 3},
+        "rank": AutoscalePolicy(max_replicas=2),
+    })
+    assert parsed["generate"].max_replicas == 3
+    assert parsed["rank"].max_replicas == 2
+    with pytest.raises(ValueError, match="non-empty dict"):
+        parse_autoscale({})
+    with pytest.raises(ValueError, match="non-empty dict"):
+        parse_autoscale("generate")
+    with pytest.raises(ValueError, match="unknown"):
+        parse_autoscale({"worker": {}})
+    with pytest.raises(ValueError, match="autoscale\\['generate'\\]"):
+        parse_autoscale({"generate": {"no_such_knob": 1}})
+    with pytest.raises(ValueError, match="must be a dict"):
+        parse_autoscale({"generate": 3})
+
+
+def test_policy_rejects_out_of_band_fields():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalePolicy(min_replicas=-1)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="step"):
+        AutoscalePolicy(step=0)
+    with pytest.raises(ValueError, match="cooldown_cycles"):
+        AutoscalePolicy(cooldown_cycles=-1)
+    with pytest.raises(ValueError, match="scale_out_queue_depth"):
+        AutoscalePolicy(scale_out_queue_depth=0)
+    with pytest.raises(ValueError, match="scale_in_load"):
+        AutoscalePolicy(scale_in_load=-0.5)
+
+
+def test_launch_eta_clamped_to_floor_and_ceiling():
+    assert clamp_launch_eta(0.01) == LAUNCH_ETA_FLOOR_S
+    assert clamp_launch_eta(7200.0) == LAUNCH_ETA_CEILING_S
+    assert clamp_launch_eta(42.0) == 42.0
+    autoscaler, _ = _autoscaler(
+        {"generate": AutoscalePolicy()}, launch_eta_s=10_000.0,
+    )
+    assert autoscaler.launch_eta_hint() == LAUNCH_ETA_CEILING_S
+    with pytest.raises(ValueError, match="launch_eta_s"):
+        _autoscaler({"generate": AutoscalePolicy()}, launch_eta_s=0)
+    with pytest.raises(ValueError, match="interval_s"):
+        _autoscaler({"generate": AutoscalePolicy()}, interval_s=0)
+
+
+# --------------------------------------------------------------------------
+# triggers, cooldown, self-healing floor
+# --------------------------------------------------------------------------
+
+def test_scale_out_on_queue_depth_with_cooldown_refractory():
+    fleet = StubFleet()
+    fleet.set("serving:0", queue_depth=5)
+    fleet.set("serving:1", queue_depth=5)
+    autoscaler, actuations = _autoscaler(
+        {"generate": AutoscalePolicy(
+            min_replicas=1, max_replicas=4,
+            scale_out_queue_depth=4.0, cooldown_cycles=2,
+        )},
+        fleet=fleet,
+    )
+    report = autoscaler.poll_once()
+    assert actuations == [("generate", 2, 3, "queue_depth_5.00")]
+    assert report["actuated"][0]["direction"] == "out"
+    # Pressure persists, but the cooldown holds for two cycles —
+    # relaunch lag must not trigger oscillation.
+    autoscaler.poll_once()
+    autoscaler.poll_once()
+    assert len(actuations) == 1
+    autoscaler.poll_once()
+    assert len(actuations) == 2
+    metrics = telemetry.get_registry()
+    assert metrics.counter(
+        "fleet/scale_events_total", kind="generate", direction="out"
+    ).value == 2
+    # The opposite direction was pre-registered at zero (scraped as an
+    # explicit 0 before any event).
+    assert metrics.counter(
+        "fleet/scale_events_total", kind="generate", direction="in"
+    ).value == 0
+
+
+def test_scale_out_on_p95_and_slo_burn_matched_by_kind():
+    fleet = StubFleet()
+    fleet.set("serving:0")
+    fleet.set("rank:0", kind="rank")
+    monitor = StubMonitor()
+    monitor.histograms["serving/ttft_seconds"] = {"p95": 2.5}
+    # A burn on a serving/* objective must scale generate, never rank.
+    monitor.slo["ttft"] = {"metric": "serving/ttft_seconds",
+                           "status": "violated"}
+    autoscaler, actuations = _autoscaler(
+        {
+            "generate": AutoscalePolicy(
+                max_replicas=3, scale_out_queue_depth=None,
+                scale_out_p95_s=1.0, cooldown_cycles=0,
+            ),
+            "rank": AutoscalePolicy(
+                max_replicas=3, scale_out_queue_depth=None,
+                scale_out_p95_s=1.0, cooldown_cycles=0,
+            ),
+        },
+        fleet=fleet, monitor=monitor,
+    )
+    autoscaler.poll_once()
+    assert actuations == [("generate", 1, 2, "p95_2.500s")]
+    # Without the p95 trigger the burn signal alone scales generate.
+    del monitor.histograms["serving/ttft_seconds"]
+    autoscaler.poll_once()
+    assert actuations[-1] == ("generate", 1, 2, "slo_burn_ttft")
+    assert all(kind == "generate" for kind, *_ in actuations)
+
+
+def test_below_min_repair_ignores_cooldown():
+    fleet = StubFleet()
+    fleet.set("serving:0", queue_depth=50)
+    autoscaler, actuations = _autoscaler(
+        {"generate": AutoscalePolicy(
+            min_replicas=2, max_replicas=4, cooldown_cycles=5,
+        )},
+        fleet=fleet,
+    )
+    autoscaler.poll_once()
+    assert actuations == [("generate", 1, 2, "below_min")]
+    # Still below min next cycle (relaunch not landed): repair again —
+    # a fleet under its floor never waits out a refractory period.
+    autoscaler.poll_once()
+    assert len(actuations) == 2
+    assert actuations[1][3] == "below_min"
+
+
+def test_scale_in_when_idle_and_fully_healthy():
+    fleet = StubFleet()
+    for i in range(3):
+        fleet.set(f"serving:{i}")
+    autoscaler, actuations = _autoscaler(
+        {"generate": AutoscalePolicy(
+            min_replicas=1, max_replicas=4,
+            scale_out_queue_depth=None, scale_in_load=0.5,
+            cooldown_cycles=0,
+        )},
+        fleet=fleet,
+    )
+    autoscaler.poll_once()
+    assert actuations == [("generate", 3, 2, "idle_load_0.00")]
+    # A PENDING replica (capacity in flight) blocks scale-in: the live
+    # fleet is not "all healthy and idle" while somebody is booting.
+    fleet.set("serving:3", state="pending")
+    autoscaler.poll_once()
+    assert len(actuations) == 1
+
+
+def test_actuator_failure_keeps_history_and_counters_clean():
+    fleet = StubFleet()
+    fleet.set("serving:0", queue_depth=9)
+
+    def refuse(kind, cur, tgt, reason):
+        return False
+
+    autoscaler, _ = _autoscaler(
+        {"generate": AutoscalePolicy(
+            max_replicas=3, scale_out_queue_depth=1.0, cooldown_cycles=0,
+        )},
+        fleet=fleet, actuate=refuse,
+    )
+    report = autoscaler.poll_once()
+    assert report["decisions"] and not report["actuated"]
+    assert autoscaler.stats()["scale_events"] == []
+    assert telemetry.get_registry().counter(
+        "fleet/scale_events_total", kind="generate", direction="out"
+    ).value == 0
+
+    def explode(kind, cur, tgt, reason):
+        raise ConnectionError("driver unreachable")
+
+    autoscaler2, _ = _autoscaler(
+        {"generate": AutoscalePolicy(
+            max_replicas=3, scale_out_queue_depth=1.0, cooldown_cycles=0,
+        )},
+        fleet=fleet, actuate=explode,
+    )
+    report = autoscaler2.poll_once()
+    assert report["decisions"] and not report["actuated"]
+
+
+def test_no_scale_out_past_max_replicas():
+    fleet = StubFleet()
+    fleet.set("serving:0", queue_depth=99)
+    fleet.set("serving:1", queue_depth=99)
+    autoscaler, actuations = _autoscaler(
+        {"generate": AutoscalePolicy(
+            max_replicas=2, scale_out_queue_depth=1.0, cooldown_cycles=0,
+        )},
+        fleet=fleet,
+    )
+    autoscaler.poll_once()
+    assert actuations == []
+
+
+# --------------------------------------------------------------------------
+# peer warm start: endpoint change is the cold-cache signal
+# --------------------------------------------------------------------------
+
+def _warm_fixture(**kwargs):
+    fleet = StubFleet()
+    fleet.set("serving:0", endpoint="127.0.0.1:9000")
+    fleet.set("serving:1", endpoint="127.0.0.1:9100")
+    pulls = []
+
+    def fetch(endpoint):
+        pulls.append(("fetch", endpoint))
+        return b'{"n_blocks": 2}'
+
+    def push(endpoint, body):
+        pulls.append(("push", endpoint))
+        return {"imported_blocks": 2, "registered_entries": 1}
+
+    autoscaler, _ = _autoscaler(
+        {"generate": AutoscalePolicy(
+            min_replicas=1, max_replicas=4,
+            scale_out_queue_depth=None, scale_in_load=None,
+        )},
+        fleet=fleet, fetch_blocks=fetch, push_blocks=push, **kwargs,
+    )
+    return fleet, autoscaler, pulls
+
+
+def test_warm_start_fires_on_endpoint_change_only_once():
+    fleet, autoscaler, pulls = _warm_fixture()
+    # First sight of a running fleet: nobody is cold, no pulls.
+    autoscaler.poll_once()
+    assert pulls == []
+    # serving:0 relaunches on a NEW port: pull from the veteran peer,
+    # push to the fresh incarnation.
+    fleet.set("serving:0", endpoint="127.0.0.1:9555")
+    autoscaler.poll_once()
+    assert pulls == [("fetch", "127.0.0.1:9100"),
+                     ("push", "127.0.0.1:9555")]
+    record = autoscaler.stats()["warm_starts"][-1]
+    assert record["task"] == "serving:0"
+    assert record["imported_blocks"] == 2
+    assert record["registered_entries"] == 1
+    assert telemetry.get_registry().counter(
+        "fleet/warm_start_blocks_total").value == 2
+    # The new endpoint is known now: no re-pull on the next cycle.
+    autoscaler.poll_once()
+    assert len(pulls) == 2
+
+
+def test_warm_start_skips_same_endpoint_readmission():
+    fleet, autoscaler, pulls = _warm_fixture()
+    autoscaler.poll_once()
+    # Ejected and re-admitted at the SAME endpoint (transient probe
+    # failure — the process never died): its cache is intact, priming
+    # it would be wasted wire.
+    fleet.set("serving:0", endpoint="127.0.0.1:9000", readmissions=1)
+    autoscaler.poll_once()
+    assert pulls == []
+
+
+def test_warm_start_newcomers_pull_from_veterans_never_each_other():
+    fleet, autoscaler, pulls = _warm_fixture()
+    autoscaler.poll_once()
+    # A two-step scale-out: both newcomers appear healthy in the same
+    # cycle. Each must pull from a VETERAN — a fellow newcomer is
+    # exactly as cold as the puller.
+    fleet.set("serving:2", endpoint="127.0.0.1:9200")
+    fleet.set("serving:3", endpoint="127.0.0.1:9300")
+    autoscaler.poll_once()
+    donors = [endpoint for op, endpoint in pulls if op == "fetch"]
+    targets = [endpoint for op, endpoint in pulls if op == "push"]
+    assert sorted(targets) == ["127.0.0.1:9200", "127.0.0.1:9300"]
+    assert set(donors) <= {"127.0.0.1:9000", "127.0.0.1:9100"}
+
+
+def test_warm_start_without_live_peer_stays_cold():
+    telemetry.get_registry().clear()
+    fleet = StubFleet()
+    fleet.set("serving:0", endpoint="127.0.0.1:9000")
+    pulls = []
+    autoscaler = FleetAutoscaler(
+        fleet, None, {"generate": AutoscalePolicy(max_replicas=2)},
+        fetch_blocks=lambda e: pulls.append(e) or b"{}",
+        push_blocks=lambda e, b: {},
+    )
+    autoscaler.poll_once()
+    fleet.set("serving:0", endpoint="127.0.0.1:9555")
+    autoscaler.poll_once()
+    assert pulls == []  # a lone relaunch has nobody warm to pull from
+
+
+def test_warm_start_pull_failure_recorded_not_retried():
+    fleet, autoscaler, pulls = _warm_fixture()
+
+    def broken_fetch(endpoint):
+        raise ConnectionError("donor mid-drain")
+
+    autoscaler._fetch_blocks = broken_fetch
+    autoscaler.poll_once()
+    fleet.set("serving:0", endpoint="127.0.0.1:9555")
+    autoscaler.poll_once()
+    record = autoscaler.stats()["warm_starts"][-1]
+    assert record["task"] == "serving:0"
+    assert "donor mid-drain" in record["error"]
+    assert telemetry.get_registry().counter(
+        "fleet/warm_start_blocks_total").value == 0
+    # Bookkeeping advanced despite the failure: the replica serves
+    # cold rather than being hammered with a pull every cycle.
+    autoscaler.poll_once()
+    assert len(autoscaler.stats()["warm_starts"]) == 1
+
+
+def test_warm_start_disabled_by_knob():
+    fleet, autoscaler, pulls = _warm_fixture(warm_start=False)
+    autoscaler.poll_once()
+    fleet.set("serving:0", endpoint="127.0.0.1:9555")
+    autoscaler.poll_once()
+    assert pulls == []
+
+
+# --------------------------------------------------------------------------
+# views + experiment knobs
+# --------------------------------------------------------------------------
+
+def test_stats_shape_and_lifecycle():
+    autoscaler, _ = _autoscaler(
+        {"generate": AutoscalePolicy(max_replicas=2)},
+        launch_eta_s=30.0,
+    )
+    stats = autoscaler.stats()
+    assert stats["cycles"] == 0
+    assert stats["launch_eta_s"] == 30.0
+    assert stats["policies"]["generate"]["max_replicas"] == 2
+    assert stats["cooldowns"] == {"generate": 0}
+    autoscaler.start()
+    autoscaler.start()  # idempotent
+    autoscaler.stop()
+    autoscaler.stop()
+
+
+def test_serving_experiment_autoscale_knobs_validate():
+    from tf_yarn_tpu.experiment import ServingExperiment
+
+    experiment = ServingExperiment(
+        model=None, model_dir="x",
+        autoscale={"generate": {"min_replicas": 1, "max_replicas": 3}},
+    )
+    assert experiment.autoscale_launch_eta_s == 15.0
+    assert experiment.autoscale_warm_start is True
+    with pytest.raises(ValueError, match="autoscale"):
+        ServingExperiment(model=None, model_dir="x",
+                          autoscale={"worker": {}})
+    with pytest.raises(ValueError, match="autoscale"):
+        ServingExperiment(
+            model=None, model_dir="x",
+            autoscale={"generate": {"max_replicas": 0}},
+        )
+    with pytest.raises(ValueError, match="autoscale_launch_eta_s"):
+        ServingExperiment(model=None, model_dir="x",
+                          autoscale_launch_eta_s=0.0)

@@ -103,3 +103,63 @@ def test_stale_fields_carry_fleet_observability_numbers(tmp_path, monkeypatch):
     # The r1 row predates the observability plane: classic carry only.
     assert fields["last_tpu_fleet_r1_tokens_per_sec"] == 21.0
     assert "last_tpu_fleet_r1_merged_ttft_p95_ms" not in fields
+
+
+def test_stale_fields_carry_fleet_autoscale_ab(tmp_path, monkeypatch):
+    # The elastic A/B (static vs autoscaled fleet) is a TPU capacity
+    # claim: its per-arm violation rates, the delta, and the stream
+    # bit-identity flag must survive CPU reruns as stale carries.
+    table = {
+        "rows": [{"samples_per_sec_per_chip": 1.0, "variant": "base"}],
+        "git_commit": "abc1234",
+        "measured_at": "2026-08-01T00:00:00Z",
+        "fleet": {
+            "rows": {},
+            "autoscale": {
+                "rows": {
+                    "static": {
+                        "slo_violation_rate": 0.2,
+                        "ttft_p95_ms": 310.0,
+                    },
+                    "autoscaled": {
+                        "slo_violation_rate": 0.05,
+                        "ttft_p95_ms": 180.0,
+                        "scale_events": 1,
+                    },
+                },
+                "violation_delta": 0.15,
+                "streams_match": True,
+            },
+        },
+    }
+    path = tmp_path / "BENCH_AB.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(bench, "_AB_PATH", str(path))
+    fields = bench._stale_tpu_fields()
+    assert (
+        fields["last_tpu_fleet_autoscale_static_slo_violation_rate"] == 0.2
+    )
+    assert fields["last_tpu_fleet_autoscale_static_ttft_p95_ms"] == 310.0
+    assert (
+        fields["last_tpu_fleet_autoscale_autoscaled_slo_violation_rate"]
+        == 0.05
+    )
+    assert fields["last_tpu_fleet_autoscale_violation_delta"] == 0.15
+    assert fields["last_tpu_fleet_autoscale_streams_match"] is True
+
+
+def test_stale_fields_tolerate_missing_autoscale_section(
+    tmp_path, monkeypatch
+):
+    # Older tables predate the elastic A/B: the carry must neither
+    # crash nor invent autoscale fields.
+    table = {
+        "rows": [{"samples_per_sec_per_chip": 1.0, "variant": "base"}],
+        "fleet": {"rows": {"r1": {"tokens_per_sec": 21.0}}},
+    }
+    path = tmp_path / "BENCH_AB.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(bench, "_AB_PATH", str(path))
+    fields = bench._stale_tpu_fields()
+    assert fields["last_tpu_fleet_r1_tokens_per_sec"] == 21.0
+    assert not any("autoscale" in key for key in fields)

@@ -86,6 +86,63 @@ def test_policy_regrow_false_pins_degraded_size():
 # --- mesh refit ------------------------------------------------------------
 
 
+def test_normalize_elastic_bands_per_task_type():
+    """The driver's elastic band(s), generalized beyond `worker`: a bare
+    policy keeps the worker-only surface, a dict makes serving/rank
+    pools elastic for the fleet autoscaler's relaunch path."""
+    from tf_yarn_tpu.client import _normalize_elastic
+    from tf_yarn_tpu.topologies import TaskSpec
+
+    specs = {
+        "worker": TaskSpec(instances=4),
+        "serving": TaskSpec(instances=2),
+        "chief": TaskSpec(instances=0),
+    }
+    band = ElasticPolicy(min_workers=1, max_workers=4)
+    assert _normalize_elastic(None, specs) == {}
+    assert _normalize_elastic(band, specs) == {"worker": band}
+    both = _normalize_elastic(
+        {"worker": band, "serving": ElasticPolicy(min_workers=1,
+                                                  max_workers=3)},
+        specs,
+    )
+    assert set(both) == {"worker", "serving"}
+    with pytest.raises(ValueError, match="ElasticPolicy"):
+        _normalize_elastic("grow please", specs)
+    with pytest.raises(ValueError, match="never resized"):
+        _normalize_elastic({"chief": band}, specs)
+    with pytest.raises(ValueError, match="never resized"):
+        _normalize_elastic({"rank": band}, specs)  # not in the topology
+    with pytest.raises(ValueError, match="elastic band"):
+        _normalize_elastic(
+            {"serving": ElasticPolicy(min_workers=3, max_workers=5)},
+            specs,
+        )
+
+
+def test_elastic_env_vars_per_task_type():
+    """`worker` keeps the legacy env names train loops already read;
+    every other elastic task type gets a derived pair."""
+    from tf_yarn_tpu.constants import (
+        ENV_ELASTIC_MAX_WORKERS,
+        ENV_ELASTIC_WORKERS,
+        elastic_env_vars,
+    )
+
+    assert elastic_env_vars("worker") == (
+        ENV_ELASTIC_WORKERS, ENV_ELASTIC_MAX_WORKERS
+    )
+    assert elastic_env_vars("serving") == (
+        "TPU_YARN_ELASTIC_SERVING", "TPU_YARN_ELASTIC_MAX_SERVING"
+    )
+    assert elastic_env_vars("rank") == (
+        "TPU_YARN_ELASTIC_RANK", "TPU_YARN_ELASTIC_MAX_RANK"
+    )
+    assert elastic_env_vars("data-feeder") == (
+        "TPU_YARN_ELASTIC_DATA_FEEDER", "TPU_YARN_ELASTIC_MAX_DATA_FEEDER"
+    )
+
+
 def test_resize_mesh_spec_rescales_data_axes():
     assert resize_mesh_spec(MeshSpec(dp=8), 4) == MeshSpec(dp=4)
     assert resize_mesh_spec(MeshSpec(fsdp=8), 4) == MeshSpec(fsdp=4)

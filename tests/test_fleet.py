@@ -285,6 +285,65 @@ def test_registry_report_failure_ejects_immediately():
     assert len(registry.refresh()) == 1
 
 
+def test_registry_relaunch_at_new_port_replaces_stale_endpoint():
+    """Satellite regression (the autoscaler's relaunch path): a replica
+    preempted and relaunched re-advertises the SAME task key with a NEW
+    host:port. The registry must adopt the new endpoint in the refresh
+    that sees it — probing the stale port would keep a live, healthy
+    incarnation out of rotation forever — and the recovery must count
+    as a readmission."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7010")
+    probe.set("127.0.0.1:7010", OK)
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0
+    )
+    assert len(registry.refresh(force=True)) == 1
+    # Preemption: the old port dies, the replica is ejected.
+    probe.set("127.0.0.1:7010", ConnectionResetError("preempted"))
+    assert registry.refresh(force=True) == []
+    assert registry.get("serving:0").state == EJECTED
+    # The relaunched incarnation advertises the same KV key at a new
+    # port. The old port still refuses — only the new one is alive.
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7011")
+    probe.set("127.0.0.1:7011", OK)
+    healthy = registry.refresh(force=True)
+    replica = registry.get("serving:0")
+    assert [r.task for r in healthy] == ["serving:0"]
+    assert replica.endpoint == "127.0.0.1:7011"
+    assert replica.state == HEALTHY
+    assert replica.readmissions == 1
+
+
+def test_registry_endpoint_change_while_healthy_is_a_relaunch():
+    """A rolling relaunch the registry never saw die: the endpoint
+    changes while the replica is HEALTHY. The stale endpoint must leave
+    rotation immediately (PENDING until the new port's first healthy
+    probe — the discovery race all over again), counted as a relaunch,
+    not a readmission."""
+    kv = InProcessKV()
+    probe = ProbeScript()
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7012")
+    probe.set("127.0.0.1:7012", OK)
+    registry = ReplicaRegistry(
+        kv, tasks=["serving:0"], probe=probe, probe_interval_s=0.0
+    )
+    assert len(registry.refresh(force=True)) == 1
+    event.serving_endpoint_event(kv, "serving:0", "127.0.0.1:7013")
+    probe.set("127.0.0.1:7013", ConnectionRefusedError("still booting"))
+    assert registry.refresh(force=True) == []
+    replica = registry.get("serving:0")
+    assert replica.endpoint == "127.0.0.1:7013"
+    assert replica.state == PENDING
+    assert replica.relaunches == 1
+    probe.set("127.0.0.1:7013", OK)
+    assert len(registry.refresh(force=True)) == 1
+    # First healthy probe at the new port is an ADMISSION of the new
+    # incarnation, not a re-admission of the old one.
+    assert replica.readmissions == 0
+
+
 # --------------------------------------------------------------------------
 # router over fake upstream replicas: the failover wire behavior
 # --------------------------------------------------------------------------
@@ -497,6 +556,53 @@ def test_router_503_with_retry_after_when_no_replica_healthy():
         assert payload["retry_after_s"] == 2.0
         assert "no generate replica" in payload["error"]
         assert router.stats()["routed_requests"]["-"]["no_replica"] == 1
+    finally:
+        router.stop()
+
+
+def test_router_empty_fleet_retry_after_reflects_autoscaler_eta():
+    """Scale-from-zero 503s: with an autoscaler attached, an EMPTY
+    generate pool is capacity that is coming, so the honest Retry-After
+    is the autoscaler's (clamped) launch ETA, not the fixed shed hint —
+    and the payload carries the ETA explicitly."""
+    from tf_yarn_tpu.fleet import AutoscalePolicy, FleetAutoscaler
+
+    kv = InProcessKV()
+    probe = ProbeScript()  # nothing advertised, nothing healthy
+    registry = ReplicaRegistry(kv, tasks=[], probe=probe)
+    autoscaler = FleetAutoscaler(
+        registry, None,
+        {"generate": AutoscalePolicy(max_replicas=2)},
+        launch_eta_s=37.0,
+    )
+    router = RouterServer(
+        registry, make_policy("least_loaded"), "127.0.0.1", 0,
+        retries=1, retry_after_s=2.0, autoscaler=autoscaler,
+    )
+    router.start()
+    try:
+        status, headers, raw = _post(
+            router.port, {"prompt": [1], "max_new_tokens": 1}
+        )
+        assert status == 503, raw
+        assert headers.get("Retry-After") == "37"
+        payload = json.loads(raw)
+        assert payload["retry_after_s"] == 37.0
+        assert payload["scale_out_eta_s"] == 37.0
+        # The hint is the validated, CLAMPED knob: a misconfigured ETA
+        # cannot park clients for an hour.
+        from tf_yarn_tpu.fleet.autoscaler import LAUNCH_ETA_CEILING_S
+
+        assert FleetAutoscaler(
+            registry, None, {"generate": AutoscalePolicy(max_replicas=2)},
+            launch_eta_s=10 ** 6,
+        ).launch_eta_hint() == LAUNCH_ETA_CEILING_S
+        # /stats surfaces the autoscaler block alongside the fleet view.
+        status, stats = _get(router.port, "/stats")
+        assert status == 200
+        assert stats["autoscaler"]["launch_eta_s"] == 37.0
+        assert stats["autoscaler"]["policies"]["generate"]["max_replicas"] \
+            == 2
     finally:
         router.stop()
 
@@ -1025,6 +1131,339 @@ def test_fleet_observability_plane_end_to_end():
         for replica in replicas:
             replica["server"].stop()
             replica["scheduler"].close()
+
+
+# --------------------------------------------------------------------------
+# fleet monitor under churn: join/leave mid-scrape never tears the view
+# --------------------------------------------------------------------------
+
+def _signals_payload(values):
+    from tf_yarn_tpu.telemetry.exposition import (
+        SIGNALS_VERSION,
+        STATS_SCHEMA_VERSION,
+    )
+    from tf_yarn_tpu.telemetry.registry import Histogram
+
+    hist = Histogram()
+    for value in values:
+        hist.observe(value)
+    return {
+        "schema_version": STATS_SCHEMA_VERSION,
+        "signals": {
+            "version": SIGNALS_VERSION,
+            "histograms": {
+                "serving/ttft_seconds": hist.to_signal(window=False),
+            },
+            "scalars": {},
+        },
+    }
+
+
+def test_monitor_churn_mid_scrape_reads_see_complete_aggregates():
+    """Fleet churn DURING a scrape cycle — a replica ejected and a new
+    one advertised while the monitor is halfway through its endpoint
+    list — must never tear the aggregate: a concurrent reader sees the
+    previous cycle's COMPLETE view until the new one is swapped in
+    whole, and the in-flight cycle still merges exactly the healthy set
+    it captured at its start."""
+    from tf_yarn_tpu.fleet import FleetMonitor
+
+    kv = InProcessKV()
+    probe = ProbeScript()
+    for index, port in enumerate((7020, 7021)):
+        event.serving_endpoint_event(kv, f"serving:{index}",
+                                     f"127.0.0.1:{port}")
+        probe.set(f"127.0.0.1:{port}", OK)
+    registry = ReplicaRegistry(kv, probe=probe, probe_interval_s=0.0)
+    registry.refresh(force=True)
+    mid_scrape = {}
+
+    def scrape(endpoint):
+        if endpoint == "127.0.0.1:7020":
+            if not mid_scrape:
+                # Churn lands mid-cycle: serving:1 leaves (preempted,
+                # its probe now refuses so the refresh keeps it out)
+                # and serving:2 joins — while THIS scrape is on the
+                # wire.
+                probe.set("127.0.0.1:7021", ConnectionResetError("gone"))
+                registry.report_failure(
+                    "serving:1", ConnectionResetError("preempted"))
+                event.serving_endpoint_event(kv, "serving:2",
+                                             "127.0.0.1:7022")
+                probe.set("127.0.0.1:7022", OK)
+                registry.refresh(force=True)
+                # The reader's view mid-churn: the last complete
+                # aggregate.
+                mid_scrape["aggregate"] = monitor.aggregate()
+            return _signals_payload([0.1] * 5)
+        if endpoint == "127.0.0.1:7021":
+            return _signals_payload([0.2] * 5)
+        return _signals_payload([0.3] * 7)
+
+    monitor = FleetMonitor(registry, scrape=scrape, interval_s=0.01)
+    first = monitor.poll_once()
+    assert first["status"] == "ok" and first["cycle"] == 1
+    assert set(first["replicas"]) == {"serving:0", "serving:1"}
+    assert first["histograms"]["serving/ttft_seconds"]["count"] == 10
+    # The mid-scrape read was cycle 1's view, complete — not a torn
+    # half-merge of the in-flight cycle 1 (the reader observed the
+    # initial no_data placeholder, whole).
+    torn = mid_scrape["aggregate"]
+    assert torn["status"] == "no_data" and "histograms" not in torn
+    # Cycle 2 runs over the POST-churn healthy set: the leaver is gone
+    # from the merge, the joiner contributes.
+    second = monitor.poll_once()
+    assert second["cycle"] == 2
+    assert set(second["replicas"]) == {"serving:0", "serving:2"}
+    assert second["histograms"]["serving/ttft_seconds"]["count"] == 12
+
+
+def test_monitor_aggregate_reads_are_consistent_under_concurrent_churn():
+    """Hammer `aggregate()` from a reader thread while scrape cycles
+    interleave with registry churn: every snapshot the reader observes
+    must be internally consistent (status/histograms agree, replica
+    views whole, cycle monotone) — deep-copied swaps, never a dict
+    mid-mutation."""
+    from tf_yarn_tpu.fleet import FleetMonitor
+
+    kv = InProcessKV()
+    probe = ProbeScript()
+    endpoints = {f"serving:{i}": f"127.0.0.1:{7030 + i}" for i in range(3)}
+    for task, endpoint in endpoints.items():
+        event.serving_endpoint_event(kv, task, endpoint)
+        probe.set(endpoint, OK)
+    registry = ReplicaRegistry(kv, probe=probe, probe_interval_s=0.0)
+    registry.refresh(force=True)
+    monitor = FleetMonitor(
+        registry, scrape=lambda endpoint: _signals_payload([0.1, 0.2]),
+        interval_s=0.001,
+    )
+    stop = threading.Event()
+    snapshots = []
+
+    def read():
+        while not stop.is_set():
+            snapshots.append(monitor.aggregate())
+
+    reader = threading.Thread(target=read)
+    reader.start()
+    try:
+        for round_index in range(8):
+            # Leave and rejoin a replica between cycles; scrape twice.
+            probe.set(endpoints["serving:1"],
+                      ConnectionResetError("flap")
+                      if round_index % 2 else OK)
+            registry.refresh(force=True)
+            monitor.poll_once()
+    finally:
+        stop.set()
+        reader.join(timeout=10)
+    assert snapshots
+    last_cycle = 0
+    for snap in snapshots:
+        assert snap["status"] in ("no_data", "ok")
+        cycle = snap.get("cycle", 0)
+        assert cycle >= last_cycle  # swapped whole, in order
+        last_cycle = cycle
+        if snap["status"] == "ok":
+            merged = snap["histograms"]["serving/ttft_seconds"]
+            # Whole-cycle counts only: every contributing replica ships
+            # 2 observations, so a torn half-merge cannot pass.
+            assert merged["count"] % 2 == 0 and merged["count"] > 0
+            for view in snap["replicas"].values():
+                assert "stale" in view and "legacy" in view
+        else:
+            assert "histograms" not in snap
+
+
+# --------------------------------------------------------------------------
+# autoscaled fleet end-to-end: burn -> scale out -> preempt -> warm re-admit
+# --------------------------------------------------------------------------
+
+def _paged_replica(engine, params, kv, task, max_slots=2):
+    from tf_yarn_tpu.serving import ServingServer, SlotScheduler
+
+    scheduler = SlotScheduler(
+        engine, params, max_slots=max_slots, kv_layout="paged",
+        block_size=4, num_blocks=32, max_seq_len=64,
+    )
+    scheduler.start()
+    server = ServingServer(scheduler, "127.0.0.1", 0)
+    server.start()
+    event.serving_endpoint_event(kv, task, server.endpoint)
+    event.heartbeat_event(kv, task)
+    return {"task": task, "scheduler": scheduler, "server": server}
+
+
+def _tiny_paged_fleet_parts():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from tf_yarn_tpu.models import transformer
+    from tf_yarn_tpu.models.decode_engine import DecodeEngine
+
+    cfg = transformer.TransformerConfig.tiny(
+        scan_layers=False, remat=False, max_seq_len=64, dtype=jnp.float32
+    )
+    model = transformer.Transformer(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    )
+    engine = DecodeEngine(
+        model, batch_buckets=(1, 2, 4), prompt_buckets=(4, 8, 16)
+    )
+    return model, params, engine
+
+
+def test_autoscaled_fleet_scales_out_and_warm_starts_readmission():
+    """The self-healing loop end-to-end on the REAL stack (tier-1
+    representative of the chaos-driven bench A/B below): an SLO burn
+    scales the generate pool out; the newcomer is warm-started over
+    real /v1/blocks HTTP from the veteran; a preempted replica
+    relaunched at a NEW port is re-admitted and warm-started from the
+    survivor; every warm replica's stream is BIT-IDENTICAL to legacy
+    and its first hot-prefix request HITS the imported cache."""
+    from tf_yarn_tpu import telemetry
+    from tf_yarn_tpu.fleet import AutoscalePolicy, FleetAutoscaler
+
+    model, params, engine = _tiny_paged_fleet_parts()
+    kv = InProcessKV()
+    fleet = {"serving:0": _paged_replica(engine, params, kv, "serving:0")}
+    registry = ReplicaRegistry(kv, probe_interval_s=0.0)
+    registry.refresh(force=True)
+    assert [r.task for r in registry.healthy()] == ["serving:0"]
+
+    burn = {"slo": {"ttft": {"metric": "serving/ttft_seconds",
+                             "status": "violated"}}}
+
+    class BurnMonitor:  # the autoscaler's monitor contract
+        def aggregate(self):
+            return dict(burn)
+
+    def actuate(kind, current, target, reason):
+        if kind != "generate":
+            return False
+        for index in range(current, target):
+            task = f"serving:{index}"
+            fleet[task] = _paged_replica(engine, params, kv, task)
+        return True
+
+    autoscaler = FleetAutoscaler(
+        registry, BurnMonitor(),
+        {"generate": AutoscalePolicy(
+            min_replicas=1, max_replicas=2, scale_out_queue_depth=None,
+            scale_in_load=None, cooldown_cycles=0,
+        )},
+        actuate=actuate, launch_eta_s=5.0,
+    )
+    metrics = telemetry.get_registry()
+    scale_before = metrics.counter(
+        "fleet/scale_events_total", kind="generate", direction="out"
+    ).value
+    blocks_before = metrics.counter("fleet/warm_start_blocks_total").value
+    try:
+        # Heat the veteran: one served prompt, bit-identical to legacy.
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, 256, (9,)).tolist()
+        expected = _legacy_stream(model, params, prompt, 6)
+        body = {"prompt": prompt, "max_new_tokens": 6}
+        status, _headers, raw = _post(
+            fleet["serving:0"]["server"].port, body, timeout=300)
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == expected
+
+        # Cycle 1: first sight records the veteran; the burn scales out.
+        report = autoscaler.poll_once()
+        assert report["actuated"][0]["reason"] == "slo_burn_ttft"
+        assert report["warm_starts"] == []  # newcomer not admitted yet
+        assert metrics.counter(
+            "fleet/scale_events_total", kind="generate", direction="out"
+        ).value == scale_before + 1
+        registry.refresh(force=True)  # admit the newcomer
+        assert len(registry.healthy()) == 2
+
+        # Cycle 2: the newcomer is healthy at a never-seen endpoint —
+        # warm-started from the veteran over real /v1/blocks HTTP.
+        report = autoscaler.poll_once()
+        warm = [w for w in report["warm_starts"]
+                if w["task"] == "serving:1"]
+        assert warm and warm[0]["imported_blocks"] >= 1, report
+        hits_before = fleet["serving:1"]["scheduler"].stats()[
+            "prefix_cache"]["hits"]
+        status, _headers, raw = _post(
+            fleet["serving:1"]["server"].port, body, timeout=300)
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == expected
+        assert fleet["serving:1"]["scheduler"].stats()[
+            "prefix_cache"]["hits"] > hits_before
+
+        # PREEMPTION: the veteran dies; relaunch advertises the SAME
+        # task at a NEW port; the registry re-admits at the new
+        # endpoint and the autoscaler warm-starts it from the survivor.
+        fleet["serving:0"]["server"].stop()
+        fleet["serving:0"]["scheduler"].close()
+        registry.report_failure(
+            "serving:0", ConnectionResetError("preempted"))
+        assert [r.task for r in registry.healthy()] == ["serving:1"]
+        fleet["serving:0"] = _paged_replica(engine, params, kv,
+                                            "serving:0")
+        registry.refresh(force=True)
+        replica = registry.get("serving:0")
+        assert replica.state == HEALTHY
+        assert replica.endpoint == fleet["serving:0"]["server"].endpoint
+        assert replica.readmissions == 1
+        report = autoscaler.poll_once()
+        warm = [w for w in report["warm_starts"]
+                if w["task"] == "serving:0"]
+        assert warm and warm[0]["imported_blocks"] >= 1, report
+        status, _headers, raw = _post(
+            fleet["serving:0"]["server"].port, body, timeout=300)
+        assert status == 200, raw
+        assert json.loads(raw)["tokens"] == expected
+        assert fleet["serving:0"]["scheduler"].stats()[
+            "prefix_cache"]["hits"] >= 1
+        assert metrics.counter(
+            "fleet/warm_start_blocks_total").value >= blocks_before + 2
+        # The history names both warm starts (autoscaler /stats block).
+        warmed_tasks = {w["task"] for w in autoscaler.stats()
+                        ["warm_starts"] if "imported_blocks" in w}
+        assert warmed_tasks == {"serving:0", "serving:1"}
+    finally:
+        for entry in fleet.values():
+            entry["server"].stop()
+            entry["scheduler"].close()
+
+
+@pytest.mark.slow  # tier-1 budget: represented by
+# test_autoscaled_fleet_scales_out_and_warm_starts_readmission (the
+# same loop, driven deterministically); this runs the full chaos-driven
+# A/B — seeded Poisson trace with a mid-run rate step + one injected
+# preemption/relaunch — static fleet vs autoscaled fleet.
+def test_bench_fleet_autoscale_ab_heals_with_streams_match():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tpu_yarn_bench_suite_fleet_autoscale_test",
+        os.path.join(repo, "benchmarks", "run.py"),
+    )
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+    result = suite.bench_fleet(tpu=False, autoscale=True)
+    rows = result["rows"]
+    for name in ("static", "autoscaled"):
+        assert rows[name].get("error") is None, rows[name]
+        assert rows[name]["dropped"] == 0  # zero dropped streams
+        assert rows[name]["readmissions"] >= 1  # the relaunch landed
+    auto = rows["autoscaled"]
+    assert auto["scale_events"] >= 1
+    assert auto["warm_start_pulls"] >= 1
+    assert auto["replicas_final"] > rows["static"]["replicas_final"]
+    # Bit-identity across arms AND vs the pre-trace reference stream.
+    assert result["streams_match"] is True
+    assert "violation_delta" in result
 
 
 # --------------------------------------------------------------------------

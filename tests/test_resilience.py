@@ -224,6 +224,51 @@ def test_parse_fault_spec_grammar():
             parse_fault_spec(bad)
 
 
+def test_parse_fleet_fault_clauses():
+    """The fleet chaos grammar: `preempt_replica_at=SECS[@TASK]` (one
+    injected preemption notice per matching replica) and
+    `rate_step=SECS,FACTOR` (trace-generator traffic shaping)."""
+    plan = parse_fault_spec(
+        "preempt_replica_at=0.5@serving:1; rate_step=0.75,3.0"
+    )
+    assert plan.preempt_replica_at == 0.5
+    assert plan.preempt_replica_task == "serving:1"
+    assert plan.rate_step == (0.75, 3.0)
+    # Without @TASK every replica matches.
+    assert parse_fault_spec(
+        "preempt_replica_at=2").preempt_replica_task is None
+    for bad in ("preempt_replica_at=-1", "preempt_replica_at=x",
+                "rate_step=0.5", "rate_step=0.5,0", "rate_step=-1,2"):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+
+def test_chaos_replica_preemption_fires_once_per_matching_task():
+    chaos.configure("preempt_replica_at=1.5@serving:0", n_try=0)
+    # Before the deadline, and for non-matching tasks: nothing.
+    assert not chaos.on_replica_poll("serving:0", 1.0)
+    assert not chaos.on_replica_poll("serving:1", 99.0)
+    # Past the deadline: True exactly ONCE — the caller treats it as
+    # the preemption notice and drains; a second notice would restart
+    # an already-draining shutdown.
+    assert chaos.on_replica_poll("serving:0", 2.0)
+    assert not chaos.on_replica_poll("serving:0", 3.0)
+    # Untargeted plans fire once per task.
+    chaos.configure("preempt_replica_at=1", n_try=0)
+    assert chaos.on_replica_poll("serving:0", 2.0)
+    assert chaos.on_replica_poll("serving:1", 2.0)
+    assert not chaos.on_replica_poll("serving:0", 3.0)
+
+
+def test_chaos_rate_step_plan_is_a_pure_read():
+    assert chaos.rate_step_plan() is None  # unarmed
+    chaos.configure("rate_step=0.25,4", n_try=0)
+    assert chaos.rate_step_plan() == (0.25, 4.0)
+    assert chaos.rate_step_plan() == (0.25, 4.0)  # reads never fire
+    chaos.configure("rate_step=0.25,4", n_try=1)  # retries disarm
+    assert chaos.rate_step_plan() is None
+
+
 def test_chaos_armed_only_on_attempt_zero():
     chaos.configure("crash_at_step=2", n_try=1)
     assert not chaos.active()

@@ -417,6 +417,89 @@ def _fleet_monitor(tracer: RaceTracer) -> None:
         raise RuntimeError("scenario never exercised stale degradation")
 
 
+def _fleet_autoscaler(tracer: RaceTracer) -> None:
+    """FleetAutoscaler poll cycles racing monitor scrapes, registry
+    refresh/ejects, and router-handler stats() reads — the elastic
+    serving decision plane. Expected fully clean: the autoscaler's
+    inputs are per-call copies (registry.snapshot, monitor.aggregate),
+    it plans and records under its own lock, and actuation/warm-start
+    HTTP runs with NO lock held (a slow peer pull must never serialize
+    against a /stats read)."""
+    from tf_yarn_tpu import event
+    from tf_yarn_tpu.coordination.kv import InProcessKV
+    from tf_yarn_tpu.fleet.autoscaler import AutoscalePolicy, FleetAutoscaler
+    from tf_yarn_tpu.fleet.monitor import FleetMonitor
+    from tf_yarn_tpu.fleet.registry import ReplicaRegistry
+    from tf_yarn_tpu.telemetry.exposition import STATS_SCHEMA_VERSION
+    from tf_yarn_tpu.telemetry.registry import Histogram
+
+    kv = InProcessKV()
+    tasks = ["serving:0", "serving:1"]
+    for index, task in enumerate(tasks):
+        kv.put_str(f"{task}/{event.SERVING_ENDPOINT}",
+                   f"127.0.0.1:{9200 + index}")
+
+    def probe(endpoint):
+        return {"status": "ok", "queue_depth": 0, "active_slots": 1}
+
+    registry = ReplicaRegistry(
+        kv, tasks, probe=probe, probe_interval_s=0.0,
+    )
+
+    def scrape(endpoint):
+        hist = Histogram()
+        for step in range(1, 4):
+            hist.observe(0.1 * step)  # p95 ~0.3s: over the trigger
+        return {
+            "schema_version": STATS_SCHEMA_VERSION,
+            "signals": {
+                "version": 1,
+                "histograms": {
+                    "serving/ttft_seconds": hist.to_signal(window=False),
+                },
+                "scalars": {},
+            },
+        }
+
+    monitor = FleetMonitor(registry, scrape=scrape, interval_s=0.0)
+    autoscaler = FleetAutoscaler(
+        registry,
+        monitor,
+        {"generate": AutoscalePolicy(
+            min_replicas=1, max_replicas=4,
+            scale_out_queue_depth=None, scale_out_p95_s=0.05,
+            scale_in_load=None, cooldown_cycles=0,
+        )},
+        actuate=lambda kind, current, target, reason: True,
+        fetch_blocks=lambda endpoint: b"{}",
+        push_blocks=lambda endpoint, body: {"imported_blocks": 1,
+                                            "registered_entries": 1},
+    )
+    tracer.watch(autoscaler, "autoscaler")
+
+    _phase("race-refresh-a", lambda: registry.refresh(force=True))
+    _phase("race-scrape-a", lambda: monitor.poll_once())
+    _phase("race-autoscale-a", lambda: autoscaler.poll_once())
+    _phase("race-eject", lambda: registry.report_failure(
+        tasks[0], ConnectionError("preempted")))
+    _phase("race-autoscale-b", lambda: autoscaler.poll_once())
+    # The relaunched incarnation re-advertises the SAME KV key at a NEW
+    # port: refresh probes the new address and re-admits (readmissions
+    # += 1), and the next cycle sees the endpoint change and
+    # warm-starts it from its peer through the injected seams.
+    _phase("race-relaunch", lambda: kv.put_str(
+        f"{tasks[0]}/{event.SERVING_ENDPOINT}", "127.0.0.1:9300"))
+    _phase("race-refresh-b", lambda: registry.refresh(force=True))
+    _phase("race-scrape-b", lambda: monitor.poll_once())
+    _phase("race-autoscale-c", lambda: autoscaler.poll_once())
+    _phase("race-stats", lambda: autoscaler.stats())
+    stats = autoscaler.stats()
+    if not stats["scale_events"]:
+        raise RuntimeError("scenario never exercised a scale decision")
+    if not any("imported_blocks" in w for w in stats["warm_starts"]):
+        raise RuntimeError("scenario never exercised a peer warm start")
+
+
 def _metrics_and_spans(tracer: RaceTracer) -> None:
     """A private MetricsRegistry + Tracer under multi-thread increments,
     span recording and flush — expected fully clean (every instrument
@@ -522,6 +605,7 @@ def default_scenarios() -> List[Scenario]:
         ),
         Scenario("fleet.registry", _registry),
         Scenario("fleet.monitor", _fleet_monitor),
+        Scenario("fleet.autoscaler", _fleet_autoscaler),
         Scenario("telemetry.metrics_spans", _metrics_and_spans),
         Scenario("checkpoint.writer", _checkpoint_writer),
     ]

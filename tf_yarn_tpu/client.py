@@ -25,7 +25,7 @@ import tempfile
 import time
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Union
 
 import cloudpickle
 
@@ -535,7 +535,9 @@ def run_on_tpu(
     wheels_dir: Optional[str] = None,
     nb_retries: int = 0,
     retry_policy: Optional[RetryPolicy] = None,
-    elastic_policy: Optional[ElasticPolicy] = None,
+    elastic_policy: Optional[
+        Union[ElasticPolicy, Dict[str, ElasticPolicy]]
+    ] = None,
     poll_every_secs: float = 0.5,
     timeout_secs: Optional[float] = None,
     dead_task_secs: Optional[float] = None,
@@ -570,7 +572,12 @@ def run_on_tpu(
     batch and the data order stay fixed. A later relaunch for any
     non-capacity kind grows back to ``max_workers``. Retries still come
     out of `retry_policy`'s budgets; the resize only changes WHAT
-    relaunches.
+    relaunches. A dict ``{task_type: ElasticPolicy}`` resizes OTHER task
+    types the same way — ``{"serving": ...}`` / ``{"rank": ...}`` is the
+    relaunch actuator behind the fleet autoscaler (docs/Fleet.md
+    "Autoscaling & self-healing"): a preempted replica relaunches on the
+    surviving count, re-advertises its new endpoint, and the router's
+    registry re-admits it. A bare policy means ``{"worker": policy}``.
 
     `experiment_fn` is a zero-arg closure returning one of the experiment
     types in `tf_yarn_tpu.experiment` (or, with the `distributed` task
@@ -640,25 +647,11 @@ def run_on_tpu(
     serialized_fn = cloudpickle.dumps(experiment_fn)
 
     policy = retry_policy or RetryPolicy.from_nb_retries(nb_retries)
-    current_workers = 0
-    if elastic_policy is not None:
-        if "worker" not in task_specs or task_specs["worker"].instances < 1:
-            raise ValueError(
-                "elastic_policy resizes the 'worker' task type; the "
-                "topology needs a worker spec with instances >= 1 "
-                "(chief and side-cars are never resized)"
-            )
-        current_workers = task_specs["worker"].instances
-        if not (
-            elastic_policy.min_workers
-            <= current_workers
-            <= elastic_policy.max_workers
-        ):
-            raise ValueError(
-                f"initial worker count {current_workers} outside the "
-                f"elastic band [{elastic_policy.min_workers}, "
-                f"{elastic_policy.max_workers}]"
-            )
+    elastic_policies = _normalize_elastic(elastic_policy, task_specs)
+    current_counts = {
+        task_type: task_specs[task_type].instances
+        for task_type in elastic_policies
+    }
     # ONE monotonic budget for the whole run: created before the first
     # attempt, never recomputed (the old per-attempt time.time() deadline
     # let nb_retries=3 run 4x timeout_secs, and NTP steps could stretch
@@ -727,40 +720,45 @@ def run_on_tpu(
                 "driver/retries_total", kind=kind.value
             ).inc()
             _note_lost_to_backend(backend, exc)
-            if elastic_policy is not None:
-                # Resize-not-retry: a capacity failure relaunches on the
-                # surviving hosts instead of blocking on full capacity;
-                # any other retryable failure is the moment to grow back.
-                lost_workers = sum(
+            for task_type, type_policy in elastic_policies.items():
+                # Resize-not-retry: a capacity failure relaunches the
+                # elastic task types on the surviving hosts instead of
+                # blocking on full capacity; any other retryable failure
+                # is the moment to grow back. Each elastic type resizes
+                # independently — a lost serving replica must not shrink
+                # the worker pool.
+                lost_count = sum(
                     1
                     for task in getattr(exc, "lost_tasks", None) or []
-                    if task.split(":", 1)[0] == "worker"
+                    if task.split(":", 1)[0] == task_type
                 )
-                new_workers = elastic_policy.plan_resize(
-                    kind, current_workers, lost_tasks=lost_workers
+                new_count = type_policy.plan_resize(
+                    kind, current_counts[task_type], lost_tasks=lost_count
                 )
-                if new_workers is not None:
-                    direction = (
-                        "shrink" if new_workers < current_workers else "grow"
-                    )
-                    _logger.warning(
-                        "elastic resize (%s): relaunching with %d workers "
-                        "(was %d) after %s",
-                        direction, new_workers, current_workers, kind.value,
-                    )
-                    telemetry.get_registry().counter(
-                        "driver/elastic_resizes_total", direction=direction
-                    ).inc()
-                    current_workers = new_workers
-                    task_specs = dict(task_specs)
-                    task_specs["worker"] = dataclasses.replace(
-                        task_specs["worker"], instances=new_workers
-                    )
-                    env = dict(env)
-                    env[constants.ENV_ELASTIC_WORKERS] = str(new_workers)
-                    env[constants.ENV_ELASTIC_MAX_WORKERS] = str(
-                        elastic_policy.max_workers
-                    )
+                if new_count is None:
+                    continue
+                direction = (
+                    "shrink" if new_count < current_counts[task_type]
+                    else "grow"
+                )
+                _logger.warning(
+                    "elastic resize (%s): relaunching with %d %s tasks "
+                    "(was %d) after %s",
+                    direction, new_count, task_type,
+                    current_counts[task_type], kind.value,
+                )
+                telemetry.get_registry().counter(
+                    "driver/elastic_resizes_total", direction=direction
+                ).inc()
+                current_counts[task_type] = new_count
+                task_specs = dict(task_specs)
+                task_specs[task_type] = dataclasses.replace(
+                    task_specs[task_type], instances=new_count
+                )
+                env = dict(env)
+                count_var, max_var = constants.elastic_env_vars(task_type)
+                env[count_var] = str(new_count)
+                env[max_var] = str(type_policy.max_workers)
             if delay:
                 time.sleep(delay)
             n_try += 1
@@ -772,6 +770,51 @@ def run_on_tpu(
                 except Exception:  # pragma: no cover - best-effort teardown
                     _logger.debug("coordination server stop failed",
                                   exc_info=True)
+
+
+def _normalize_elastic(
+    elastic_policy, task_specs
+) -> Dict[str, ElasticPolicy]:
+    """The elastic band(s) as ``{task_type: ElasticPolicy}``, validated
+    against the topology. A bare policy keeps PR 8's worker-only
+    surface (-> ``{"worker": policy}``); a dict makes any task type
+    elastic — ``serving`` / ``rank`` replica pools for the fleet
+    autoscaler's relaunch path. Raises ValueError on a type missing
+    from the topology or an initial count outside its band."""
+    if elastic_policy is None:
+        return {}
+    if isinstance(elastic_policy, ElasticPolicy):
+        policies = {"worker": elastic_policy}
+    elif isinstance(elastic_policy, dict):
+        policies = dict(elastic_policy)
+    else:
+        raise ValueError(
+            "elastic_policy must be an ElasticPolicy or a "
+            f"{{task_type: ElasticPolicy}} dict, got {elastic_policy!r}"
+        )
+    for task_type, type_policy in policies.items():
+        if not isinstance(type_policy, ElasticPolicy):
+            raise ValueError(
+                f"elastic_policy[{task_type!r}] must be an ElasticPolicy, "
+                f"got {type_policy!r}"
+            )
+        if task_type not in task_specs \
+                or task_specs[task_type].instances < 1:
+            raise ValueError(
+                f"elastic_policy resizes the {task_type!r} task type; "
+                f"the topology needs a {task_type!r} spec with instances "
+                ">= 1 (chief and side-cars are never resized)"
+            )
+        count = task_specs[task_type].instances
+        if not (
+            type_policy.min_workers <= count <= type_policy.max_workers
+        ):
+            raise ValueError(
+                f"initial {task_type} count {count} outside the "
+                f"elastic band [{type_policy.min_workers}, "
+                f"{type_policy.max_workers}]"
+            )
+    return policies
 
 
 def _note_lost_to_backend(backend, exc: Exception) -> None:

@@ -45,3 +45,20 @@ ENV_NB_PROC = "TPU_YARN_NB_PROC"
 # `train/degraded` gauge from the ratio.
 ENV_ELASTIC_WORKERS = "TPU_YARN_ELASTIC_WORKERS"
 ENV_ELASTIC_MAX_WORKERS = "TPU_YARN_ELASTIC_MAX_WORKERS"
+
+
+def elastic_env_vars(task_type: str) -> tuple:
+    """(count var, max var) the driver sets for a resized task type.
+
+    'worker' keeps the legacy names above — train loops already read
+    them — and every other elastic task type (``serving``, ``rank``:
+    the fleet autoscaler's relaunch path) gets a derived pair, e.g.
+    ``TPU_YARN_ELASTIC_SERVING`` / ``TPU_YARN_ELASTIC_MAX_SERVING``.
+    """
+    if task_type == "worker":
+        return ENV_ELASTIC_WORKERS, ENV_ELASTIC_MAX_WORKERS
+    suffix = task_type.upper().replace("-", "_")
+    return (
+        f"TPU_YARN_ELASTIC_{suffix}",
+        f"TPU_YARN_ELASTIC_MAX_{suffix}",
+    )

@@ -59,6 +59,12 @@ ROUTER_ENDPOINT = "router_endpoint"
 # which key it advertised, so the router's path-aware dispatch never
 # sends a /v1/rank request to a token-decode replica.
 RANK_ENDPOINT = "rank_endpoint"
+# Autoscaler desired-capacity advertisement (tf_yarn_tpu.fleet
+# .autoscaler): the router-side decision plane publishes the per-kind
+# replica count it wants; the driver's elastic relaunch path (and any
+# operator) reads it. Kind rides in the key so the generate and rank
+# advertisements never clobber each other.
+FLEET_DESIRED = "fleet_desired"
 
 
 def wait(kv: KVStore, key: str, timeout: Optional[float] = None) -> str:
@@ -174,6 +180,22 @@ def rank_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
 
 def rank_endpoint_event_name(task: str) -> str:
     return f"{task}/{RANK_ENDPOINT}"
+
+
+def fleet_desired_event(kv: KVStore, task: str, kind: str,
+                        replicas: int, reason: str = "") -> None:
+    """Advertise the autoscaler's desired replica count for one kind
+    (JSON payload: replicas + reason). Last write wins — the value is a
+    desired STATE, not an event log."""
+    import json
+
+    broadcast(kv, fleet_desired_event_name(task, kind), json.dumps({
+        "kind": kind, "replicas": int(replicas), "reason": reason,
+    }))
+
+
+def fleet_desired_event_name(task: str, kind: str) -> str:
+    return f"{task}/{FLEET_DESIRED}_{kind}"
 
 
 def metrics_event(kv: KVStore, task: str, payload: str) -> None:

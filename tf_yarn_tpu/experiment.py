@@ -387,6 +387,20 @@ class ServingExperiment:
     # router's FleetMonitor evaluates the same objectives fleet-wide
     # over the merged histograms — the canary-rollback trigger.
     slo: Optional[Dict[str, float]] = None
+    # Fleet autoscaling (tf_yarn_tpu/fleet/autoscaler.py, docs/Fleet.md
+    # "Autoscaling & self-healing"), read only by the ``router`` task:
+    # ``autoscale`` maps replica kind ('generate' / 'rank') to an
+    # AutoscalePolicy field dict, e.g.
+    # ``{"generate": {"min_replicas": 1, "max_replicas": 4}}``; None
+    # (default) = no autoscaler side-car. ``autoscale_launch_eta_s`` is
+    # how long a scaled-out replica takes to become routable — the
+    # Retry-After an EMPTY pool's 503 carries (clamped to
+    # [LAUNCH_ETA_FLOOR_S, LAUNCH_ETA_CEILING_S]).
+    # ``autoscale_warm_start`` primes (re-)admitted generate replicas'
+    # prefix caches from a live peer via /v1/blocks.
+    autoscale: Optional[Dict[str, Dict]] = None
+    autoscale_launch_eta_s: float = 15.0
+    autoscale_warm_start: bool = True
 
     def __post_init__(self) -> None:
         if self.max_slots < 1:
@@ -534,6 +548,18 @@ class ServingExperiment:
                 parse_slo(self.slo)
             except ValueError as exc:
                 raise ValueError(f"slo: {exc}") from exc
+        if self.autoscale is not None:
+            from tf_yarn_tpu.fleet.autoscaler import parse_autoscale
+
+            try:
+                parse_autoscale(self.autoscale)
+            except ValueError as exc:
+                raise ValueError(f"autoscale: {exc}") from exc
+        if not self.autoscale_launch_eta_s > 0:
+            raise ValueError(
+                f"autoscale_launch_eta_s must be > 0, got "
+                f"{self.autoscale_launch_eta_s}"
+            )
 
 
 @dataclasses.dataclass

@@ -18,6 +18,12 @@ framework-owned placement TF-Replicator argues for (PAPERS.md):
   quantiles, with last-good/stale degradation and fleet-scope SLO
   evaluation — the aggregate signal the autoscaler and canary
   rollback consume.
+* :mod:`~tf_yarn_tpu.fleet.autoscaler` — the self-healing elastic
+  loop: per-kind `AutoscalePolicy` thresholds over the monitor
+  aggregate (queue depth, fleet p95, SLO burn) drive scale-out /
+  scale-in decisions through a pluggable actuator, and generate
+  replicas (re-)entering the healthy set are warm-started by pulling
+  hot prefix-cache blocks from a live peer (``/v1/blocks``).
 * :mod:`~tf_yarn_tpu.fleet.router` — the router HTTP task: the same
   ``/v1/generate`` (streaming passthrough) / ``/healthz`` / ``/stats``
   surface as one replica, with budgeted retry-on-another-replica
@@ -26,6 +32,12 @@ framework-owned placement TF-Replicator argues for (PAPERS.md):
   `topologies.fleet_topology`).
 """
 
+from tf_yarn_tpu.fleet.autoscaler import (  # noqa: F401
+    AutoscalePolicy,
+    FleetAutoscaler,
+    ScaleEvent,
+    parse_autoscale,
+)
 from tf_yarn_tpu.fleet.monitor import (  # noqa: F401
     FleetMonitor,
     http_scrape,
@@ -48,7 +60,9 @@ from tf_yarn_tpu.fleet.registry import (  # noqa: F401
 from tf_yarn_tpu.fleet.router import RouterServer, run_router  # noqa: F401
 
 __all__ = [
+    "AutoscalePolicy",
     "EJECTED",
+    "FleetAutoscaler",
     "FleetMonitor",
     "HEALTHY",
     "LeastLoadedPolicy",
@@ -59,8 +73,10 @@ __all__ = [
     "RoundRobinPolicy",
     "RouterServer",
     "STOPPED",
+    "ScaleEvent",
     "http_probe",
     "http_scrape",
     "make_policy",
+    "parse_autoscale",
     "run_router",
 ]

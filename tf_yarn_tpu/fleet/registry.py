@@ -107,7 +107,13 @@ class Replica:
     last_probe_at: Optional[float] = None
     ejections: int = 0
     readmissions: int = 0
+    relaunches: int = 0
     ever_beat: bool = False
+    # Endpoint the replica was advertising when its stop tombstone was
+    # observed. A later advertisement under a DIFFERENT endpoint marks
+    # the tombstone as belonging to a previous incarnation — the
+    # relaunched task is alive and must be probed back in.
+    stopped_endpoint: Optional[str] = None
     # /healthz payload schema version; None = a legacy (pre-versioning)
     # replica that never sent one. Mixed-version fleets keep routing —
     # the version only informs readers like the monitor, never gates
@@ -130,6 +136,7 @@ class Replica:
             "eject_reason": self.eject_reason,
             "ejections": self.ejections,
             "readmissions": self.readmissions,
+            "relaunches": self.relaunches,
             "schema_version": self.schema_version,
         }
 
@@ -253,11 +260,39 @@ class ReplicaRegistry:
             return
         if endpoint is None:
             return  # not advertised yet: nothing to probe
-        replica.endpoint = endpoint
+        if replica.endpoint is not None and endpoint != replica.endpoint:
+            # Relaunched incarnation: the task re-advertised the SAME KV
+            # key with a NEW host:port. The cached address is dead weight
+            # — replace it NOW and clear the probe clock so THIS refresh
+            # probes the new address instead of waiting out the throttle
+            # (or worse, keeping a HEALTHY replica routed to the corpse).
+            _logger.info(
+                "replica %s re-advertised %s (was %s); probing the new "
+                "address", replica.task, endpoint, replica.endpoint,
+            )
+            replica.endpoint = endpoint
+            replica.last_probe_at = None
+            replica.relaunches += 1
+            self._registry.counter("fleet/replica_relaunches_total").inc()
+            if replica.state in (HEALTHY, STOPPED):
+                # Out of rotation until the new incarnation proves
+                # healthy; EJECTED stays ejected so the healthy probe
+                # below counts a readmission.
+                replica.state = PENDING
+                replica.eject_reason = None
+        else:
+            replica.endpoint = endpoint
         if stopped:
-            # Finished is not dead: out of rotation, no ejection counted.
-            replica.state = STOPPED
-            return
+            if (replica.stopped_endpoint is None
+                    or replica.stopped_endpoint == endpoint):
+                # Finished is not dead: out of rotation, no ejection
+                # counted.
+                replica.state = STOPPED
+                replica.stopped_endpoint = endpoint
+                return
+            # The tombstone predates the current incarnation (the task
+            # re-advertised a NEW endpoint after stopping): stale — fall
+            # through and probe the live address.
         if beat_raw is not None:
             replica.ever_beat = True
             age = heartbeat_age(beat_raw, now=self._wall_clock())

@@ -120,6 +120,7 @@ class RouterServer:
         retry_after_s: float = 1.0,
         upstream_timeout_s: float = 600.0,
         monitor=None,
+        autoscaler=None,
     ):
         self.registry = registry
         self.policy = policy if policy is not None else make_policy(
@@ -133,6 +134,12 @@ class RouterServer:
         # fleet/* gauges ride /metrics. Lifecycle belongs to the
         # caller (run_router starts/stops it around the serve loop).
         self.monitor = monitor
+        # Optional fleet.FleetAutoscaler side-car: when attached, its
+        # decision history rides /stats and an EMPTY pool's 503 carries
+        # the (clamped) launch ETA as Retry-After — scale-from-zero
+        # clients back off for as long as capacity actually takes to
+        # arrive, not a fixed second. Lifecycle belongs to the caller.
+        self.autoscaler = autoscaler
         self._metrics = telemetry.get_registry()
         self._routed: Dict[str, Dict[str, int]] = {}
         self._routed_lock = threading.Lock()
@@ -210,6 +217,8 @@ class RouterServer:
         }
         if self.monitor is not None:
             out["fleet"] = self.monitor.aggregate()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
         return out
 
 
@@ -396,15 +405,26 @@ def _make_handler(router: RouterServer):
             # after a reply must already include it.
             router._count("-", "no_replica")
             retry_after = max(router.retry_after_s, busy_hint)
+            body = {"retry_after_s": retry_after}
+            if (
+                router.autoscaler is not None
+                and not router.registry.healthy(kind=kind)
+            ):
+                # Scale-from-zero: the kind's pool is EMPTY (not just
+                # busy), so the honest Retry-After is the autoscaler's
+                # launch ETA — how long a scaled-out replica takes to
+                # become routable — not the fixed shed hint.
+                eta = router.autoscaler.launch_eta_hint()
+                retry_after = max(retry_after, eta)
+                body["scale_out_eta_s"] = eta
+            body["retry_after_s"] = retry_after
+            body["error"] = (
+                f"no {kind} replica available: "
+                f"{last_error}; retry in ~{retry_after:.1f}s"
+            )
             self._json(
                 503,
-                {
-                    "error": (
-                        f"no {kind} replica available: "
-                        f"{last_error}; retry in ~{retry_after:.1f}s"
-                    ),
-                    "retry_after_s": retry_after,
-                },
+                body,
                 headers=(("Retry-After",
                           str(max(1, int(retry_after)))),),
             )
@@ -546,6 +566,33 @@ def run_router(experiment, runtime) -> dict:
     monitor = FleetMonitor(
         registry, slo=getattr(experiment, "slo", None),
     )
+    autoscaler = None
+    autoscale_spec = getattr(experiment, "autoscale", None)
+    if autoscale_spec:
+        from tf_yarn_tpu.fleet.autoscaler import FleetAutoscaler
+
+        def _advertise_desired(kind: str, current: int, target: int,
+                               reason: str) -> bool:
+            # The cluster actuator: publish the desired per-kind count
+            # in the coordination KV. The driver's elastic relaunch
+            # path (client.py, elastic_policy={'serving': ...}) — and
+            # any operator — consumes it; the decision plane and the
+            # actuator compose through re-admission, not a private RPC.
+            event.fleet_desired_event(
+                runtime.kv, runtime.task, kind, target, reason,
+            )
+            return True
+
+        autoscaler = FleetAutoscaler(
+            registry,
+            monitor,
+            autoscale_spec,
+            actuate=_advertise_desired,
+            launch_eta_s=getattr(
+                experiment, "autoscale_launch_eta_s", None,
+            ) or 15.0,
+            warm_start=getattr(experiment, "autoscale_warm_start", True),
+        )
     server = RouterServer(
         registry,
         make_policy(experiment.router_policy),
@@ -554,8 +601,11 @@ def run_router(experiment, runtime) -> dict:
         retries=experiment.router_retries,
         retry_after_s=experiment.retry_after_s,
         monitor=monitor,
+        autoscaler=autoscaler,
     )
     monitor.start()
+    if autoscaler is not None:
+        autoscaler.start()
     endpoint = server.start()
     advertised = advertised_endpoint(experiment.router_host, server.port)
     event.router_endpoint_event(runtime.kv, runtime.task, advertised)
@@ -582,6 +632,8 @@ def run_router(experiment, runtime) -> dict:
             registry.refresh()
             time.sleep(POLL_S)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         monitor.stop()
         server.stop()
         stats = {"endpoint": advertised, **server.stats()}

@@ -25,6 +25,18 @@ Spec grammar (``TPU_YARN_FAULT``, ``;``-separated clauses)::
     truncate_ckpt=latest  after the next checkpoint commit, truncate its
                           largest payload file (the manifest then fails
                           verification on restore)
+    preempt_replica_at=SECS[@TASK]
+                          SECS after a serving replica's poll loop
+                          starts, deliver it the preemption notice
+                          (drain → /healthz "draining" → router
+                          ejection — the fleet self-healing trigger).
+                          ``@serving:1`` targets one replica; without a
+                          task every replica sharing the process drains
+    rate_step=SECS,FACTOR traffic shaping for trace generators: declare
+                          that request arrival rate multiplies by
+                          FACTOR at SECS into the trace (consumed by
+                          the fleet bench/e2e harnesses through
+                          `rate_step_plan()`, not an in-process hook)
 
 ``TPU_YARN_FAULT_SEED`` seeds the probabilistic clauses (default 0).
 
@@ -71,6 +83,9 @@ class FaultPlan:
     lose_host_task: Optional[str] = None  # "type:id"; None = every task
     kv_delay: Optional[Tuple[float, float]] = None  # (probability, seconds)
     truncate_ckpt: Optional[str] = None  # "latest"
+    preempt_replica_at: Optional[float] = None  # seconds into serving
+    preempt_replica_task: Optional[str] = None  # "type:id"; None = every
+    rate_step: Optional[Tuple[float, float]] = None  # (seconds, factor)
     seed: int = 0
 
     def any(self) -> bool:
@@ -80,6 +95,8 @@ class FaultPlan:
             self.lose_host_at_step is not None,
             self.kv_delay is not None,
             self.truncate_ckpt is not None,
+            self.preempt_replica_at is not None,
+            self.rate_step is not None,
         ))
 
 
@@ -107,6 +124,21 @@ def parse_fault_spec(spec: str, seed: int = 0) -> FaultPlan:
             elif key == "kv_delay":
                 prob, _, secs = value.partition(",")
                 fields[key] = (float(prob), float(secs))
+            elif key == "preempt_replica_at":
+                secs_str, _, task = value.partition("@")
+                fields[key] = float(secs_str)
+                if fields[key] < 0:
+                    raise ValueError(value)
+                if task:
+                    fields["preempt_replica_task"] = task
+            elif key == "rate_step":
+                secs_str, _, factor_str = value.partition(",")
+                if not factor_str:
+                    raise ValueError(value)
+                secs, factor = float(secs_str), float(factor_str)
+                if secs < 0 or factor <= 0:
+                    raise ValueError(value)
+                fields[key] = (secs, factor)
             elif key == "truncate_ckpt":
                 if value != "latest":
                     raise ValueError(value)
@@ -238,6 +270,43 @@ def on_kv_op(op: str) -> None:
     if inj.rng.random() < prob:
         _logger.debug("chaos: delaying kv %s by %.3fs", op, secs)
         time.sleep(secs)
+
+
+def on_replica_poll(task: str, elapsed_s: float) -> bool:
+    """Serving poll-loop boundary: called once per loop iteration with
+    the replica's task name and seconds since serving began. Returns
+    True exactly ONCE (per matching task) when the plan's
+    ``preempt_replica_at`` deadline has elapsed — the caller treats it
+    as the preemption notice and drains (the same path a real notice
+    takes), so the router ejects the replica before its socket dies."""
+    inj = _active()
+    if inj is None or inj.plan.preempt_replica_at is None:
+        return False
+    plan = inj.plan
+    if plan.preempt_replica_task is not None \
+            and plan.preempt_replica_task != task:
+        return False
+    if elapsed_s < plan.preempt_replica_at:
+        return False
+    key = f"preempt_replica:{task}"
+    if key in inj.fired:
+        return False
+    inj.fired.add(key)
+    _logger.warning(
+        "chaos: injecting preemption notice for %s at %.2fs",
+        task, elapsed_s,
+    )
+    return True
+
+
+def rate_step_plan() -> Optional[Tuple[float, float]]:
+    """The armed plan's ``rate_step`` clause (seconds, factor), or None.
+    Trace generators (the fleet bench/e2e harnesses) consult this when
+    synthesizing arrivals — pure read, nothing fires."""
+    inj = _active()
+    if inj is None:
+        return None
+    return inj.plan.rate_step
 
 
 def on_checkpoint_commit(ckpt_uri: str) -> None:

@@ -208,6 +208,41 @@ class PrefixCache:
                 self._evict_one()
         return stored
 
+    def export_entries(
+        self, limit: Optional[int] = None
+    ) -> List[Tuple[bytes, List[int]]]:
+        """Hot-first (most-recently-used first) view of the cache:
+        ``(content key, block ids)`` pairs. The warm-start donor path
+        (``GET /v1/blocks``) ships these to a freshly admitted peer —
+        the blake2b keys are content addresses, so identical prompt
+        prefixes hash identically on every replica and the receiver can
+        install them directly under the same keys."""
+        if limit is not None and limit < 0:
+            raise ValueError(f"limit must be >= 0, got {limit}")
+        items = list(self._entries.items())
+        items.reverse()  # OrderedDict iterates LRU-first; hot end last
+        if limit is not None:
+            items = items[:limit]
+        return [(key, list(ids)) for key, ids in items]
+
+    def register_imported(self, key: bytes, ids: Sequence[int]) -> bool:
+        """Install a peer-transferred entry under its content address.
+        The caller holds its own reference on every id (the fresh
+        allocation from the import path); the cache retains one more on
+        top, exactly like `register`. Returns whether the entry was
+        stored (False: already cached, or capacity 0)."""
+        if self.capacity == 0:
+            return False
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        kept = list(ids)
+        self.pool.retain(kept)
+        self._entries[key] = kept
+        if len(self._entries) > self.capacity:
+            self._evict_one()
+        return True
+
     def _evict_one(self) -> int:
         key, ids = self._entries.popitem(last=False)  # LRU end
         return self.pool.release(ids)

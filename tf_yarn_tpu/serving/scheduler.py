@@ -165,6 +165,22 @@ class _Suspended:
         return self.state.request
 
 
+class _ControlOp:
+    """One cross-thread request into the scheduler thread (prefix
+    export/import for the fleet warm-start path). The caller blocks on
+    `done`; the scheduler services queued ops at the top of each tick —
+    the paging classes stay scheduler-thread-only, no new locks."""
+
+    __slots__ = ("kind", "arg", "done", "result", "error")
+
+    def __init__(self, kind: str, arg):
+        self.kind = kind
+        self.arg = arg
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
 class SlotScheduler:
     """Continuous batching over a fixed slot grid (module docstring).
 
@@ -380,6 +396,10 @@ class SlotScheduler:
         self._trace_id_lock = threading.Lock()
         self._ticks = 0
         self._draining = False
+        # Pending cross-thread control ops (prefix export/import),
+        # serviced by the scheduler thread at the top of each tick.
+        self._control: Deque[_ControlOp] = collections.deque()
+        self._control_lock = threading.Lock()
         self._work = threading.Event()
         self._stop = threading.Event()
         self._lifecycle = threading.Lock()
@@ -558,6 +578,7 @@ class SlotScheduler:
     def tick(self) -> bool:
         """One scheduling round; returns whether any work happened (the
         loop idles when it returns False)."""
+        self._run_control_ops()
         now = time.monotonic()
         admitted: List[int] = []
         retired: List = []
@@ -1018,6 +1039,202 @@ class SlotScheduler:
                 time.monotonic() - started
             )
         return True
+
+    # -- prefix warm start (fleet peer transfer) -----------------------------
+
+    def export_hot_prefixes(self, limit: Optional[int] = None,
+                            timeout_s: float = 30.0) -> Dict:
+        """Snapshot the hottest prefix-cache entries WITH their KV block
+        payloads, for priming a freshly (re)admitted peer replica. Wire
+        form (JSON-ready once the payload pytree is encoded):
+        ``{schema_version, block_size, n_blocks, entries: [{key(hex),
+        blocks: [index into the donor block list]}], payload}`` where
+        ``payload`` is the `extract_blocks` pytree with leading dim
+        ``n_blocks`` — int8 pools ship their int8 rows as-is, the 4x
+        wire saving for free. Blocks shared across entries are shipped
+        once (the index list dedupes). Runs ON the scheduler thread via
+        the control-op queue; any thread may call it."""
+        return self._control_call("export", limit, timeout_s)
+
+    def import_prefixes(self, wire: Dict, timeout_s: float = 30.0) -> Dict:
+        """Install a peer's `export_hot_prefixes` snapshot: allocate
+        local blocks (evicting LRU prefix entries if needed, never
+        touching active slots), `inject_blocks` the payload rows, and
+        register each entry under its content key — identical prompts
+        hash identically, so later admissions hit through the normal
+        lookup. Hot-first clipping when the local pool cannot hold the
+        whole snapshot. Returns ``{imported_blocks, registered_entries,
+        skipped_entries}``."""
+        return self._control_call("import", wire, timeout_s)
+
+    def _control_call(self, kind: str, arg, timeout_s: float):
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "prefix warm start needs kv_layout='paged' — the dense "
+                "layout has no block pool or prefix cache to transfer"
+            )
+        op = _ControlOp(kind, arg)
+        with self._control_lock:
+            self._control.append(op)
+        self._work.set()
+        with self._lifecycle:
+            loop_running = self._thread is not None
+        if not loop_running:
+            # No loop thread (tests driving tick() by hand, or a grid
+            # not yet started): the caller is the de-facto scheduler
+            # thread — service the queue in place.
+            self._run_control_ops()
+        if not op.done.wait(timeout_s):
+            raise TimeoutError(
+                f"scheduler did not service {kind} within {timeout_s}s"
+            )
+        if op.error is not None:
+            raise op.error
+        return op.result
+
+    def _run_control_ops(self) -> None:
+        while True:
+            with self._control_lock:
+                if not self._control:
+                    return
+                op = self._control.popleft()
+            try:
+                if op.kind == "export":
+                    op.result = self._export_prefixes_now(op.arg)
+                elif op.kind == "import":
+                    op.result = self._import_prefixes_now(op.arg)
+                else:
+                    raise ValueError(f"unknown control op {op.kind!r}")
+            except BaseException as exc:  # delivered to the caller
+                op.error = exc
+            op.done.set()
+
+    def _export_prefixes_now(self, limit: Optional[int]) -> Dict:
+        import jax
+
+        entries = self._prefix.export_entries(limit)
+        donor_ids: List[int] = []
+        index: Dict[int, int] = {}
+        wire_entries: List[Dict] = []
+        for key, ids in entries:
+            for block in ids:
+                if block not in index:
+                    index[block] = len(donor_ids)
+                    donor_ids.append(block)
+            wire_entries.append({
+                "key": key.hex(),
+                "blocks": [index[block] for block in ids],
+            })
+        # Extract in groups of the block-table width — the SAME compile
+        # key as the suspend path. Each group's payload ships verbatim
+        # (padded tail rows included) as a FLAT leaf list: the payload
+        # pytree mirrors the pool, so the receiver rebuilds it against
+        # its own pool's treedef — no structure goes over the wire, and
+        # an int8 pool's rows ship as int8.
+        width = self._blocks_per_slot
+        groups: List[Dict] = []
+        for start in range(0, len(donor_ids), width):
+            chunk = donor_ids[start:start + width]
+            ids_arr = np.full((width,), TRASH_BLOCK, np.int32)
+            ids_arr[:len(chunk)] = chunk
+            payload = _to_host(self.engine.extract_blocks(
+                self.params, self._pool, ids_arr, self._block_size
+            ))
+            leaves, _ = jax.tree_util.tree_flatten(
+                payload, is_leaf=_none_leaf
+            )
+            groups.append({"n_blocks": len(chunk), "leaves": leaves})
+        if donor_ids:
+            self._registry.counter(
+                "serving/prefix_export_blocks_total").inc(len(donor_ids))
+        return {
+            "schema_version": 1,
+            "block_size": self._block_size,
+            "group_width": width,
+            "n_blocks": len(donor_ids),
+            "entries": wire_entries,
+            "groups": groups,
+        }
+
+    def _import_prefixes_now(self, wire: Dict) -> Dict:
+        import jax
+
+        block_size = int(wire.get("block_size") or 0)
+        if block_size != self._block_size:
+            raise ValueError(
+                f"peer block_size {block_size} != local "
+                f"{self._block_size}; refusing to import KV blocks"
+            )
+        n_blocks = int(wire.get("n_blocks") or 0)
+        entries = list(wire.get("entries") or [])
+        groups = list(wire.get("groups") or [])
+        width = int(wire.get("group_width") or 0)
+        empty = {"imported_blocks": 0, "registered_entries": 0,
+                 "skipped_entries": len(entries)}
+        if not n_blocks or not entries or not groups or width < 1:
+            return empty
+        # Hot-first clipping: take the longest prefix of (hot-ordered)
+        # entries whose distinct blocks the pool can cover with free +
+        # cache-evictable capacity. Active slots are never raided.
+        coverable = (self._blocks.free_blocks
+                     + self._prefix.evictable_blocks())
+        needed: Dict[int, None] = {}
+        selected: List[Dict] = []
+        for entry in entries:
+            fresh = [i for i in entry["blocks"] if i not in needed]
+            if len(needed) + len(fresh) > coverable:
+                break
+            for i in fresh:
+                needed[i] = None
+            selected.append(entry)
+        if not selected:
+            return empty
+        self._prefix.evict_for(len(needed))
+        owned = self._blocks.allocate(len(needed))
+        if owned is None:
+            return empty
+        mapping = dict(zip(needed, owned))
+        # Payload rows keep their donor group/row coordinates; rows we
+        # did not select (clipped) aim at the trash block.
+        treedef = jax.tree_util.tree_structure(
+            self._pool, is_leaf=_none_leaf
+        )
+        for g, group in enumerate(groups):
+            ids_arr = np.full((width,), TRASH_BLOCK, np.int32)
+            wanted = False
+            for j in range(int(group["n_blocks"])):
+                local = mapping.get(g * width + j)
+                if local is not None:
+                    ids_arr[j] = local
+                    wanted = True
+            if not wanted:
+                continue
+            payload = jax.tree_util.tree_unflatten(
+                treedef, group["leaves"]
+            )
+            self._pool = self.engine.inject_blocks(
+                self.params, self._pool, ids_arr, payload,
+                self._block_size,
+            )
+        registered = 0
+        # Cold-to-hot so the donor's hottest entries land at the MRU
+        # end of the local LRU.
+        for entry in reversed(selected):
+            if self._prefix.register_imported(
+                bytes.fromhex(entry["key"]),
+                [mapping[i] for i in entry["blocks"]],
+            ):
+                registered += 1
+        # Cache entries hold their own references now; dropping the
+        # allocation reference frees any block no registered entry kept.
+        self._blocks.release(owned)
+        self._registry.counter(
+            "serving/prefix_import_blocks_total").inc(len(owned))
+        return {
+            "imported_blocks": len(owned),
+            "registered_entries": registered,
+            "skipped_entries": len(entries) - len(selected),
+        }
 
     def _step(self, active: List[int], retired: List) -> None:
         tokens = np.zeros((self.max_slots,), np.int32)
@@ -1488,3 +1705,9 @@ def _to_host(tree):
     import jax
 
     return jax.device_get(tree)
+
+
+def _none_leaf(x) -> bool:
+    """is_leaf predicate keeping None leaves (a pool's index leaves) in
+    flattened swap payloads, mirroring the engine's own tree_maps."""
+    return x is None

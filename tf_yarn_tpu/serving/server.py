@@ -19,6 +19,12 @@ framework and the protocol is deliberately tiny:
   human twin.
 * ``GET /stats`` — the scheduler snapshot + decode-engine compile
   stats as JSON.
+* ``GET /v1/blocks[?limit=N]`` / ``POST /v1/blocks`` — the fleet
+  warm-start protocol (docs/Fleet.md): GET exports the hottest prefix-
+  cache entries with their KV block payloads (blake2b content keys,
+  base64 ndarray leaves — int8 pools ship quantized); POST installs a
+  peer's export into the local pool + prefix cache. Paged layout only
+  (409 otherwise).
 
 `run_serving` is the task program body (tasks/serving.py): restore the
 checkpoint exactly as batch inference does, build the shared
@@ -29,13 +35,17 @@ deadline/SIGTERM-drain/duration says stop.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import socket
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+import numpy as np
 
 from tf_yarn_tpu import telemetry
 from tf_yarn_tpu.serving.request import (
@@ -46,6 +56,63 @@ from tf_yarn_tpu.serving.request import (
 from tf_yarn_tpu.serving.scheduler import SlotScheduler
 
 _logger = logging.getLogger(__name__)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # Extension dtypes (bfloat16 …) resolve through ml_dtypes, which
+        # jax ships; plain numpy alone raises for them.
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_block_wire(wire: dict) -> dict:
+    """JSON-ready copy of a scheduler `export_hot_prefixes` snapshot:
+    each payload leaf becomes ``{"dtype", "shape", "b64"}`` (None
+    leaves stay null) — an int8 pool's quantized bytes ship as-is, the
+    4x wire saving for free."""
+    out = dict(wire)
+    groups = []
+    for group in wire.get("groups") or []:
+        leaves = []
+        for leaf in group["leaves"]:
+            if leaf is None:
+                leaves.append(None)
+                continue
+            arr = np.ascontiguousarray(leaf)
+            leaves.append({
+                "dtype": str(arr.dtype),
+                "shape": list(arr.shape),
+                "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+            })
+        groups.append({"n_blocks": int(group["n_blocks"]),
+                       "leaves": leaves})
+    out["groups"] = groups
+    return out
+
+
+def decode_block_wire(wire: dict) -> dict:
+    """Inverse of `encode_block_wire`: rebuild numpy payload leaves for
+    `SlotScheduler.import_prefixes`."""
+    out = dict(wire)
+    groups = []
+    for group in wire.get("groups") or []:
+        leaves = []
+        for leaf in group["leaves"]:
+            if leaf is None:
+                leaves.append(None)
+                continue
+            arr = np.frombuffer(
+                base64.b64decode(leaf["b64"]), dtype=_np_dtype(leaf["dtype"])
+            ).reshape(leaf["shape"])
+            leaves.append(arr)
+        groups.append({"n_blocks": int(group["n_blocks"]),
+                       "leaves": leaves})
+    out["groups"] = groups
+    return out
 
 
 class ServingServer:
@@ -122,6 +189,24 @@ def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
         # -- routes ----------------------------------------------------
 
         def do_GET(self):
+            path, _, query = self.path.partition("?")
+            if path == "/v1/blocks":
+                try:
+                    params = urllib.parse.parse_qs(query)
+                    limit = (int(params["limit"][0])
+                             if "limit" in params else None)
+                except (TypeError, ValueError) as exc:
+                    self._json(400, {"error": f"bad limit: {exc}"})
+                    return
+                try:
+                    wire = scheduler.export_hot_prefixes(limit)
+                except ValueError as exc:
+                    # Dense layout / no prefix machinery: the warm-start
+                    # protocol does not apply to this replica.
+                    self._json(409, {"error": str(exc)})
+                    return
+                self._json(200, encode_block_wire(wire))
+                return
             if self.path == "/healthz":
                 from tf_yarn_tpu import preemption
 
@@ -166,6 +251,25 @@ def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
                 self._json(404, {"error": f"unknown path {self.path}"})
 
         def do_POST(self):
+            if self.path == "/v1/blocks":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    wire = decode_block_wire(
+                        json.loads(self.rfile.read(length) or b"{}")
+                    )
+                except Exception as exc:
+                    self._json(400, {"error": f"bad block wire: {exc}"})
+                    return
+                try:
+                    result = scheduler.import_prefixes(wire)
+                except Exception as exc:
+                    # Layout/geometry mismatch (dense layout, different
+                    # block_size, foreign pool structure): refuse, keep
+                    # serving.
+                    self._json(409, {"error": str(exc)})
+                    return
+                self._json(200, result)
+                return
             if self.path != "/v1/generate":
                 self._json(404, {"error": f"unknown path {self.path}"})
                 return
@@ -392,8 +496,17 @@ def run_serving(experiment, runtime=None) -> dict:
         time.monotonic() + experiment.serve_seconds
         if experiment.serve_seconds is not None else None
     )
+    from tf_yarn_tpu.resilience import chaos
+
+    serve_began = time.monotonic()
     try:
         while True:
+            if chaos.on_replica_poll(
+                telemetry_task, time.monotonic() - serve_began
+            ):
+                # Injected preemption notice (TPU_YARN_FAULT
+                # preempt_replica_at): same drain path as the real flag.
+                preemption.request()
             if preemption.requested():
                 _logger.info("serving task draining on preemption notice")
                 scheduler.drain()  # surfaced in /healthz + /stats

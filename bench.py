@@ -424,6 +424,21 @@ def _stale_tpu_fields() -> dict:
     for key in ("peak_streams_ratio", "interactive_ttft_p95_ratio"):
         if key in overload_ab:
             fields[f"last_tpu_serve_overload_{key}"] = overload_ab[key]
+    disagg_ab = serve.get("disagg") or {}
+    for row_name, row in (disagg_ab.get("rows") or {}).items():
+        if isinstance(row, dict) and "ttft_p95_ms" in row:
+            fields[f"last_tpu_serve_disagg_{row_name}_ttft_p95_ms"] = row[
+                "ttft_p95_ms"
+            ]
+    for key in ("ttft_p95_ratio", "wire_bytes_fp_over_int8"):
+        if key in disagg_ab:
+            fields[f"last_tpu_serve_disagg_{key}"] = disagg_ab[key]
+    if "streams_match_local" in (
+        (disagg_ab.get("rows") or {}).get("offloaded") or {}
+    ):
+        fields["last_tpu_serve_disagg_streams_match_local"] = disagg_ab[
+            "rows"
+        ]["offloaded"]["streams_match_local"]
     fleet = table.get("fleet") or {}
     for row_name, row in (fleet.get("rows") or {}).items():
         if isinstance(row, dict) and "tokens_per_sec" in row:
@@ -714,7 +729,7 @@ def bench_flagship_train():
             _log(f"decode bench FAILED: {type(exc).__name__}: {exc}")
         try:
             serve = suite.bench_serve(tpu=True, tp=True, chunked=True,
-                                      overload=True)
+                                      overload=True, disagg=True)
             ab["serve"] = serve
             _write_ab(ab)
             # Online-serving headline pair: continuous-batching
@@ -808,6 +823,24 @@ def bench_flagship_train():
             for key in ("suspends", "resumes", "streams_match_hold"):
                 if key in suspend_row:
                     result[f"serve_overload_{key}"] = suspend_row[key]
+            # Disaggregated-prefill A/B: offloaded vs local TTFT p95 on
+            # the bimodal trace through a real prefill replica over
+            # HTTP; streams_match_local is the bit-identity evidence
+            # and the fp-vs-int8 ratio the wire saving.
+            disagg_ab = serve.get("disagg") or {}
+            for row_name, row in (disagg_ab.get("rows") or {}).items():
+                if isinstance(row, dict) and "ttft_p95_ms" in row:
+                    result[f"serve_disagg_{row_name}_ttft_p95_ms"] = row[
+                        "ttft_p95_ms"
+                    ]
+            for key in ("ttft_p95_ratio", "wire_bytes_fp_over_int8"):
+                if key in disagg_ab:
+                    result[f"serve_disagg_{key}"] = disagg_ab[key]
+            offloaded_row = (disagg_ab.get("rows") or {}).get(
+                "offloaded") or {}
+            for key in ("streams_match_local", "ships", "shipped_blocks"):
+                if key in offloaded_row:
+                    result[f"serve_disagg_{key}"] = offloaded_row[key]
             _log(f"serve: {serve}")
         except Exception as exc:
             _log(f"serve bench FAILED: {type(exc).__name__}: {exc}")
@@ -912,7 +945,7 @@ def _record_cpu_serve_ab(result: dict) -> None:
     try:
         suite = _load_bench_suite()
         serve = suite.bench_serve(tpu=False, tp=True, chunked=True,
-                                  overload=True)
+                                  overload=True, disagg=True)
     except Exception as exc:  # the bench headline must still print
         _log(f"cpu serve bench FAILED: {type(exc).__name__}: {exc}")
         return
@@ -990,6 +1023,19 @@ def _record_cpu_serve_ab(result: dict) -> None:
     for key in ("suspends", "resumes", "streams_match_hold"):
         if key in suspend_row:
             result[f"serve_cpu_overload_{key}"] = suspend_row[key]
+    # Disaggregated-prefill A/B: the bit-identity flag and the
+    # fp-vs-int8 wire ratio are scheduling/format properties and hold
+    # anywhere; the CPU rig's TTFT ratio is device-shaped and is NOT
+    # recorded as speed evidence (the section's note says so).
+    disagg_ab = serve.get("disagg") or {}
+    offloaded_row = (disagg_ab.get("rows") or {}).get("offloaded") or {}
+    for key in ("streams_match_local", "ships", "shipped_blocks"):
+        if key in offloaded_row:
+            result[f"serve_cpu_disagg_{key}"] = offloaded_row[key]
+    if "wire_bytes_fp_over_int8" in disagg_ab:
+        result["serve_cpu_disagg_wire_bytes_fp_over_int8"] = disagg_ab[
+            "wire_bytes_fp_over_int8"
+        ]
     try:
         with open(_AB_PATH) as fh:
             table = json.load(fh)

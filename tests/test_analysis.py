@@ -32,8 +32,9 @@ HLO_RULES = sorted(code for code, rule in RULES.items() if rule.engine == "hlo")
 CONC_STATIC_RULES = ["TYA301", "TYA302", "TYA303"]
 SCENARIO_NAMES = {
     "serving.slot_scheduler", "serving.suspend_resume",
-    "ranking.micro_batch", "fleet.registry", "fleet.monitor",
-    "fleet.autoscaler", "telemetry.metrics_spans", "checkpoint.writer",
+    "serving.prefill_ship", "ranking.micro_batch", "fleet.registry",
+    "fleet.monitor", "fleet.autoscaler", "telemetry.metrics_spans",
+    "checkpoint.writer",
 }
 
 
@@ -175,6 +176,7 @@ def test_checker_clean_over_telemetry_and_instrumented_sites():
         "tf_yarn_tpu/tasks/serving.py",
         "tf_yarn_tpu/tasks/rank.py",
         "tf_yarn_tpu/tasks/router.py",
+        "tf_yarn_tpu/tasks/prefill.py",
         "tf_yarn_tpu/checkpoint.py",
         "tf_yarn_tpu/client.py",
         "tf_yarn_tpu/coordination/kv.py",

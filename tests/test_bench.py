@@ -148,6 +148,60 @@ def test_stale_fields_carry_fleet_autoscale_ab(tmp_path, monkeypatch):
     assert fields["last_tpu_fleet_autoscale_streams_match"] is True
 
 
+def test_stale_fields_carry_serve_disagg_ab(tmp_path, monkeypatch):
+    # The disaggregated-prefill A/B is a TPU latency claim: both rows'
+    # TTFT p95, the ratio, the stream bit-identity flag, and the
+    # fp-vs-int8 wire ratio must survive CPU reruns as stale carries.
+    table = {
+        "rows": [{"samples_per_sec_per_chip": 1.0, "variant": "base"}],
+        "git_commit": "abc1234",
+        "measured_at": "2026-08-01T00:00:00Z",
+        "serve": {
+            "disagg": {
+                "rows": {
+                    "local": {"ttft_p95_ms": 95.0},
+                    "offloaded": {
+                        "ttft_p95_ms": 61.0,
+                        "streams_match_local": True,
+                        "ships": 4,
+                        "shipped_blocks": 512,
+                    },
+                },
+                "ttft_p95_ratio": 0.642,
+                "wire_bytes_fp_over_int8": 3.1,
+            },
+        },
+    }
+    path = tmp_path / "BENCH_AB.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(bench, "_AB_PATH", str(path))
+    fields = bench._stale_tpu_fields()
+    assert fields["last_tpu_serve_disagg_local_ttft_p95_ms"] == 95.0
+    assert fields["last_tpu_serve_disagg_offloaded_ttft_p95_ms"] == 61.0
+    assert fields["last_tpu_serve_disagg_ttft_p95_ratio"] == 0.642
+    assert fields["last_tpu_serve_disagg_wire_bytes_fp_over_int8"] == 3.1
+    assert fields["last_tpu_serve_disagg_streams_match_local"] is True
+
+
+def test_stale_fields_tolerate_missing_disagg_section(tmp_path, monkeypatch):
+    # Older tables predate the disaggregated-prefill A/B: the carry
+    # must neither crash nor invent disagg fields.
+    table = {
+        "rows": [{"samples_per_sec_per_chip": 1.0, "variant": "base"}],
+        "serve": {
+            "chunked": {
+                "rows": {"chunked": {"itl_p95_ms": 5.0, "ttft_p95_ms": 7.0}},
+            },
+        },
+    }
+    path = tmp_path / "BENCH_AB.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(bench, "_AB_PATH", str(path))
+    fields = bench._stale_tpu_fields()
+    assert fields["last_tpu_serve_chunked_chunked_itl_p95_ms"] == 5.0
+    assert not any("disagg" in key for key in fields)
+
+
 def test_stale_fields_tolerate_missing_autoscale_section(
     tmp_path, monkeypatch
 ):

@@ -15,6 +15,7 @@ EVALUATOR_MODULE = "tf_yarn_tpu.tasks.evaluator"
 SERVING_MODULE = "tf_yarn_tpu.tasks.serving"
 ROUTER_MODULE = "tf_yarn_tpu.tasks.router"
 RANK_MODULE = "tf_yarn_tpu.tasks.rank"
+PREFILL_MODULE = "tf_yarn_tpu.tasks.prefill"
 
 
 def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) -> str:
@@ -28,4 +29,6 @@ def gen_task_module(task_type: str, custom_task_module: Optional[str] = None) ->
         return custom_task_module or ROUTER_MODULE
     if task_type == "rank":
         return custom_task_module or RANK_MODULE
+    if task_type == "prefill":
+        return custom_task_module or PREFILL_MODULE
     return custom_task_module or WORKER_MODULE

@@ -270,6 +270,67 @@ def _suspend_resume(tracer: RaceTracer) -> None:
         raise RuntimeError("scenario never exercised a suspend")
 
 
+def _prefill_ship(tracer: RaceTracer) -> None:
+    """Disaggregated prefill under concurrent ships: PrefillWorker
+    builds wires on per-connection threads (its ONE lock is the whole
+    discipline), PrefillClient ships/imports from a frontend handler
+    thread while the decode scheduler ticks and stats snapshot from
+    others — the serving/prefill.py hot path end to end, minus the
+    HTTP socket (the ``post=`` seam calls the worker directly)."""
+    import json
+
+    from tf_yarn_tpu.serving.prefill import (
+        PrefillClient,
+        PrefillTierConfig,
+        PrefillWorker,
+    )
+    from tf_yarn_tpu.serving.scheduler import SlotScheduler
+    from tf_yarn_tpu.serving.server import encode_block_wire
+
+    worker = PrefillWorker(_FakePagedEngine(), params=None, block_size=4)
+    scheduler = SlotScheduler(
+        _FakePagedEngine(), params=None, max_slots=2,
+        kv_layout="paged", block_size=4, max_seq_len=32,
+    )
+
+    def post(endpoint, prompt, timeout_s):
+        return json.dumps(
+            encode_block_wire(worker.prefill_prompt(prompt))
+        ).encode()
+
+    client = PrefillClient(
+        PrefillTierConfig(offload_threshold=5, endpoint="127.0.0.1:1"),
+        scheduler, block_size=4, post=post,
+    )
+    tracer.watch(worker, "worker")
+    tracer.watch(worker._blocks, "worker_pool")
+    tracer.watch(worker._prefix, "worker_prefix")
+    tracer.watch(client, "client")
+    tracer.watch(scheduler, "scheduler")
+    tracer.watch(scheduler._blocks, "pool")
+    tracer.watch(scheduler._prefix, "prefix")
+
+    prompt = list(range(1, 10))
+    outcomes: list = []
+    _phase("race-ship",
+           lambda: outcomes.append(client.maybe_ship(prompt)))
+    _phase("race-prefill-b",
+           lambda: worker.prefill_prompt(list(range(2, 11))))
+    _phase("race-drive",
+           lambda: drive_paged_scheduler(scheduler, [prompt]))
+    _phase("race-stats", lambda: (worker.stats(), client.stats(),
+                                  scheduler.stats()))
+    # Re-shipping the same content from yet another handler thread must
+    # stop at the client's memo — no second import races the live grid
+    # (imports ride the scheduler control queue; hand-driven here, the
+    # importing caller IS the de-facto scheduler thread).
+    _phase("race-ship-b",
+           lambda: outcomes.append(client.maybe_ship(prompt)))
+    _phase("race-worker-stats", lambda: worker.stats())
+    if outcomes != ["shipped", "already_shipped"]:
+        raise RuntimeError(f"unexpected ship outcomes: {outcomes}")
+
+
 def _micro_batch(tracer: RaceTracer) -> None:
     """MicroBatchScheduler under concurrent /v1/rank-style submits,
     ticks and stats — the ranking hot path."""
@@ -596,6 +657,10 @@ def default_scenarios() -> List[Scenario]:
                 ("prefix.misses", _ADVISORY),
             ),
         ),
+        # No allow= entries: every shared field in the prefill tier is
+        # lock-guarded (worker lock / client lock), and the single
+        # import rides the scheduler control queue.
+        Scenario("serving.prefill_ship", _prefill_ship),
         Scenario(
             "ranking.micro_batch", _micro_batch,
             allow=(

@@ -47,7 +47,9 @@ KILLED = "KILLED"
 # ARE primary: a crashed server fails (and relaunches) the run — and so
 # are ranking replicas and the fleet router, the one endpoint every
 # client dials.
-PRIMARY_TASK_TYPES = ("chief", "worker", "serving", "rank", "router")
+PRIMARY_TASK_TYPES = (
+    "chief", "worker", "serving", "rank", "router", "prefill",
+)
 
 
 @dataclass

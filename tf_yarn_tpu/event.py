@@ -59,6 +59,12 @@ ROUTER_ENDPOINT = "router_endpoint"
 # which key it advertised, so the router's path-aware dispatch never
 # sends a /v1/rank request to a token-decode replica.
 RANK_ENDPOINT = "rank_endpoint"
+# Disaggregated-prefill discovery (tf_yarn_tpu.serving.prefill): a
+# prefill-tier replica advertises under its OWN suffix — again the
+# capability declaration. Decode replicas resolve the tier from this
+# key (two-stage dispatch pulls, so /v1/generate routing is untouched)
+# and the fleet registry tags the replica kind "prefill" from it.
+PREFILL_ENDPOINT = "prefill_endpoint"
 # Autoscaler desired-capacity advertisement (tf_yarn_tpu.fleet
 # .autoscaler): the router-side decision plane publishes the per-kind
 # replica count it wants; the driver's elastic relaunch path (and any
@@ -180,6 +186,17 @@ def rank_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
 
 def rank_endpoint_event_name(task: str) -> str:
     return f"{task}/{RANK_ENDPOINT}"
+
+
+def prefill_endpoint_event(kv: KVStore, task: str, endpoint: str) -> None:
+    """Advertise a prefill-tier task's HTTP endpoint (``host:port``).
+    The distinct suffix doubles as the replica's capability declaration
+    — see PREFILL_ENDPOINT."""
+    broadcast(kv, f"{task}/{PREFILL_ENDPOINT}", endpoint)
+
+
+def prefill_endpoint_event_name(task: str) -> str:
+    return f"{task}/{PREFILL_ENDPOINT}"
 
 
 def fleet_desired_event(kv: KVStore, task: str, kind: str,

@@ -401,6 +401,13 @@ class ServingExperiment:
     autoscale: Optional[Dict[str, Dict]] = None
     autoscale_launch_eta_s: float = 15.0
     autoscale_warm_start: bool = True
+    # Disaggregated prefill (docs/Serving.md "Disaggregated prefill"):
+    # PrefillTierConfig field dict, e.g. ``{"offload_threshold": 256}``.
+    # When set (and kv_layout == "paged"), /v1/generate pulls long
+    # prompts' KV blocks from the ``prefill`` task tier before
+    # submitting; None (default) = always prefill locally. Also the
+    # experiment read by the ``prefill`` task itself (tasks/prefill.py).
+    prefill_tier: Optional[Dict] = None
 
     def __post_init__(self) -> None:
         if self.max_slots < 1:
@@ -560,6 +567,13 @@ class ServingExperiment:
                 f"autoscale_launch_eta_s must be > 0, got "
                 f"{self.autoscale_launch_eta_s}"
             )
+        if self.prefill_tier is not None:
+            from tf_yarn_tpu.serving.prefill import parse_prefill_tier
+
+            try:
+                parse_prefill_tier(self.prefill_tier)
+            except ValueError as exc:
+                raise ValueError(f"prefill_tier: {exc}") from exc
 
 
 @dataclasses.dataclass

@@ -66,6 +66,7 @@ from tf_yarn_tpu.fleet.monitor import FleetMonitor
 from tf_yarn_tpu.fleet.registry import (
     HEALTHY,
     KIND_GENERATE,
+    KIND_PREFILL,
     KIND_RANK,
     PENDING,
     ReplicaRegistry,
@@ -73,7 +74,7 @@ from tf_yarn_tpu.fleet.registry import (
 
 _logger = logging.getLogger(__name__)
 
-KINDS = (KIND_GENERATE, KIND_RANK)
+KINDS = (KIND_GENERATE, KIND_RANK, KIND_PREFILL)
 
 # Bounds on the launch-ETA hint the router's empty-fleet 503s carry as
 # Retry-After: the floor keeps clients from hammering a fleet that is
@@ -89,13 +90,22 @@ DEFAULT_INTERVAL_S = 1.0
 DEFAULT_SIGNALS = {
     KIND_GENERATE: "serving/ttft_seconds",
     KIND_RANK: "ranking/request_seconds",
+    # Prefill replicas report their per-request build latency; a
+    # saturated tier shows up as a fattening p95 (the tier has no queue
+    # of its own — decode replicas fall back locally instead of
+    # waiting, so latency IS the pressure signal).
+    KIND_PREFILL: "serving/prefill_build_seconds",
 }
 
 # SLO objectives are matched to a kind by their metric prefix: a burn
 # on serving/* scales the generate pool, ranking/* the rank pool.
+# Prefill shares the serving/ namespace but must not double-claim those
+# burns — a TTFT burn scales the GENERATE pool (local fallback keeps it
+# the bottleneck); the prefill tier scales on its p95 signal alone.
 _KIND_METRIC_PREFIXES = {
     KIND_GENERATE: ("serving/",),
     KIND_RANK: ("ranking/",),
+    KIND_PREFILL: (),
 }
 
 
@@ -142,13 +152,13 @@ class AutoscalePolicy:
 
 def parse_autoscale(spec: Dict[str, Any]) -> Dict[str, AutoscalePolicy]:
     """Validate an ``autoscale=`` experiment knob: a dict keyed by
-    replica kind (``generate`` / ``rank``) whose values are
+    replica kind (``generate`` / ``rank`` / ``prefill``) whose values are
     `AutoscalePolicy` field dicts (or ready policies). Raises ValueError
     naming the offending key, in the experiment-validation style."""
     if not isinstance(spec, dict) or not spec:
         raise ValueError(
             "autoscale must be a non-empty dict keyed by replica kind "
-            f"('generate' / 'rank'), got {spec!r}"
+            f"('generate' / 'rank' / 'prefill'), got {spec!r}"
         )
     policies: Dict[str, AutoscalePolicy] = {}
     for kind, policy in spec.items():

@@ -64,6 +64,7 @@ DEFAULT_PROBE_INTERVAL_S = 1.0
 # replica that publishes rank_endpoint serves /v1/rank, nothing else).
 KIND_GENERATE = "generate"
 KIND_RANK = "rank"
+KIND_PREFILL = "prefill"
 
 
 def http_probe(endpoint: str,
@@ -203,6 +204,7 @@ class ReplicaRegistry:
         suffixes = {
             f"/{event.SERVING_ENDPOINT}": KIND_GENERATE,
             f"/{event.RANK_ENDPOINT}": KIND_RANK,
+            f"/{event.PREFILL_ENDPOINT}": KIND_PREFILL,
         }
         try:
             keys = self._kv.keys("")
@@ -223,25 +225,26 @@ class ReplicaRegistry:
         from tf_yarn_tpu import event
 
         try:
-            # Read the endpoint from the replica's own kind's key; when
-            # the kind is not yet known (explicit tasks= list), whichever
-            # key the task published resolves it.
-            primary = (
-                event.RANK_ENDPOINT if replica.kind == KIND_RANK
-                else event.SERVING_ENDPOINT
-            )
-            fallback = (
-                event.SERVING_ENDPOINT if replica.kind == KIND_RANK
-                else event.RANK_ENDPOINT
-            )
-            endpoint = self._kv.get_str(f"{replica.task}/{primary}")
-            if endpoint is None:
-                endpoint = self._kv.get_str(f"{replica.task}/{fallback}")
+            # Read the endpoint from the replica's own kind's key first;
+            # when the kind is not yet known (explicit tasks= list),
+            # whichever key the task published resolves it — the suffix
+            # IS the capability declaration.
+            kind_keys = {
+                KIND_GENERATE: event.SERVING_ENDPOINT,
+                KIND_RANK: event.RANK_ENDPOINT,
+                KIND_PREFILL: event.PREFILL_ENDPOINT,
+            }
+            ordered = [replica.kind] + [
+                kind for kind in kind_keys if kind != replica.kind
+            ]
+            endpoint = None
+            for kind in ordered:
+                endpoint = self._kv.get_str(
+                    f"{replica.task}/{kind_keys[kind]}"
+                )
                 if endpoint is not None:
-                    replica.kind = (
-                        KIND_GENERATE if replica.kind == KIND_RANK
-                        else KIND_RANK
-                    )
+                    replica.kind = kind
+                    break
             stopped = (
                 self._kv.get_str(
                     f"{replica.task}/{event.HEARTBEAT_STOPPED}"

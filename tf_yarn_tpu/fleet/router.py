@@ -553,7 +553,11 @@ def run_router(experiment, runtime) -> dict:
     serving_tasks = [
         instance.key.to_kv_str()
         for instance in getattr(runtime, "cluster_tasks", [])
-        if instance.key.type in ("serving", "rank")
+        # prefill replicas never receive routed requests (PATH_KINDS is
+        # the dispatch key and /v1/generate pulls from the tier), but
+        # the registry tracks their health so the monitor merges their
+        # signals and the autoscaler can size the tier.
+        if instance.key.type in ("serving", "rank", "prefill")
     ] or None  # None -> discover by KV scan
     registry = ReplicaRegistry(
         runtime.kv,

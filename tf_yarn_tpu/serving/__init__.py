@@ -30,6 +30,13 @@ online server (docs/Serving.md):
 * :mod:`~tf_yarn_tpu.serving.server` — the threaded stdlib HTTP
   frontend (``/v1/generate``, ``/healthz``, ``/stats``) and
   `run_serving`, the body of the ``serving`` task type.
+* :mod:`~tf_yarn_tpu.serving.prefill` — disaggregated prefill: the
+  ``prefill`` task tier runs ONLY bucketed prefill and ships the
+  resulting KV blocks to decode replicas over the content-addressed
+  block wire; decode's ``PrefillClient`` pulls blocks per long prompt
+  and lands them as prefix-cache entries, so admission skips the
+  shipped span ("Disaggregated prefill" in docs/Serving.md). Every
+  failure mode degrades to local prefill.
 
 Launch through :func:`tf_yarn_tpu.client.run_on_tpu` with a
 ``ServingExperiment`` and a ``serving`` task spec
@@ -41,6 +48,15 @@ from tf_yarn_tpu.serving.paging import (  # noqa: F401
     BlockPool,
     HostBlockStore,
     PrefixCache,
+)
+from tf_yarn_tpu.serving.prefill import (  # noqa: F401
+    PrefillClient,
+    PrefillServer,
+    PrefillTierConfig,
+    PrefillWorker,
+    kv_prefill_resolver,
+    parse_prefill_tier,
+    run_prefill,
 )
 from tf_yarn_tpu.serving.request import (  # noqa: F401
     DEFAULT_TIER,
@@ -75,6 +91,10 @@ __all__ = [
     "FINISH_LENGTH",
     "FINISH_SHUTDOWN",
     "HostBlockStore",
+    "PrefillClient",
+    "PrefillServer",
+    "PrefillTierConfig",
+    "PrefillWorker",
     "PrefixCache",
     "QueueFull",
     "Request",
@@ -85,6 +105,9 @@ __all__ = [
     "SlotScheduler",
     "TIERS",
     "advertised_endpoint",
+    "kv_prefill_resolver",
+    "parse_prefill_tier",
+    "run_prefill",
     "run_serving",
     "tier_rank",
 ]

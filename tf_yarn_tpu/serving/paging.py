@@ -40,6 +40,22 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 TRASH_BLOCK = 0  # physical block 0: write target for masked-off slots
 
 
+def prefix_keys(prompt: Sequence[int], block_size: int,
+                max_k: int) -> List[bytes]:
+    """One constant-size content key per whole-block prefix length
+    (k = 1..max_k), computed incrementally — O(len(prompt)) hashing
+    total, not O(len^2). Module-level because the key format IS the
+    cross-replica wire contract: the prefill tier and the decode-side
+    shipped-prefix memo must hash exactly like :class:`PrefixCache`."""
+    digest = hashlib.blake2b(digest_size=16)
+    keys = []
+    for k in range(1, max_k + 1):
+        for token in prompt[(k - 1) * block_size: k * block_size]:
+            digest.update(int(token).to_bytes(8, "little", signed=True))
+        keys.append(digest.copy().digest())
+    return keys
+
+
 class BlockPool:
     """Free-list + refcount ledger for `num_blocks` physical KV blocks.
 
@@ -151,17 +167,7 @@ class PrefixCache:
         return self.hits / lookups if lookups else 0.0
 
     def _prefix_keys(self, prompt: Sequence[int], max_k: int) -> List[bytes]:
-        """One constant-size content key per whole-block prefix length
-        (k = 1..max_k), computed incrementally — O(len(prompt)) hashing
-        total, not O(len^2)."""
-        bs = self.pool.block_size
-        digest = hashlib.blake2b(digest_size=16)
-        keys = []
-        for k in range(1, max_k + 1):
-            for token in prompt[(k - 1) * bs: k * bs]:
-                digest.update(int(token).to_bytes(8, "little", signed=True))
-            keys.append(digest.copy().digest())
-        return keys
+        return prefix_keys(prompt, self.pool.block_size, max_k)
 
     def lookup(self, prompt: Sequence[int],
                max_tokens: int) -> Tuple[int, List[int]]:

@@ -1112,7 +1112,20 @@ class SlotScheduler:
     def _export_prefixes_now(self, limit: Optional[int]) -> Dict:
         import jax
 
-        entries = self._prefix.export_entries(limit)
+        # Snapshot refs on the control path: `export_entries` is only a
+        # VIEW of the cache — between it and the device extract below,
+        # an eviction (a hand-driven tick, a reentrant control op, or
+        # anything the extract itself triggers) can release an entry's
+        # blocks, and a subsequent admission can reallocate and pack
+        # OVER them: the export would ship freshly-overwritten rows
+        # under the old content key. Drop entries whose blocks already
+        # hit refcount 0, then retain every surviving donor id for the
+        # duration of the extract so no donor block can return to the
+        # free list mid-export.
+        entries = [
+            (key, ids) for key, ids in self._prefix.export_entries(limit)
+            if all(self._blocks.refcount(block) > 0 for block in ids)
+        ]
         donor_ids: List[int] = []
         index: Dict[int, int] = {}
         wire_entries: List[Dict] = []
@@ -1131,19 +1144,23 @@ class SlotScheduler:
         # pytree mirrors the pool, so the receiver rebuilds it against
         # its own pool's treedef — no structure goes over the wire, and
         # an int8 pool's rows ship as int8.
+        self._blocks.retain(donor_ids)
         width = self._blocks_per_slot
         groups: List[Dict] = []
-        for start in range(0, len(donor_ids), width):
-            chunk = donor_ids[start:start + width]
-            ids_arr = np.full((width,), TRASH_BLOCK, np.int32)
-            ids_arr[:len(chunk)] = chunk
-            payload = _to_host(self.engine.extract_blocks(
-                self.params, self._pool, ids_arr, self._block_size
-            ))
-            leaves, _ = jax.tree_util.tree_flatten(
-                payload, is_leaf=_none_leaf
-            )
-            groups.append({"n_blocks": len(chunk), "leaves": leaves})
+        try:
+            for start in range(0, len(donor_ids), width):
+                chunk = donor_ids[start:start + width]
+                ids_arr = np.full((width,), TRASH_BLOCK, np.int32)
+                ids_arr[:len(chunk)] = chunk
+                payload = _to_host(self.engine.extract_blocks(
+                    self.params, self._pool, ids_arr, self._block_size
+                ))
+                leaves, _ = jax.tree_util.tree_flatten(
+                    payload, is_leaf=_none_leaf
+                )
+                groups.append({"n_blocks": len(chunk), "leaves": leaves})
+        finally:
+            self._blocks.release(donor_ids)
         if donor_ids:
             self._registry.counter(
                 "serving/prefix_export_blocks_total").inc(len(donor_ids))

@@ -121,14 +121,15 @@ class ServingServer:
     client never blocks admissions."""
 
     def __init__(self, scheduler: SlotScheduler, host: str = "127.0.0.1",
-                 port: int = 0, *, slo_evaluator=None):
-        handler = _make_handler(scheduler, slo_evaluator)
+                 port: int = 0, *, slo_evaluator=None, prefill_client=None):
+        handler = _make_handler(scheduler, slo_evaluator, prefill_client)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self._lifecycle = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.scheduler = scheduler
         self.slo_evaluator = slo_evaluator
+        self.prefill_client = prefill_client
 
     @property
     def port(self) -> int:
@@ -162,7 +163,8 @@ class ServingServer:
             thread.join(timeout=10.0)
 
 
-def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
+def _make_handler(scheduler: SlotScheduler, slo_evaluator=None,
+                  prefill_client=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -238,6 +240,8 @@ def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
                 }
                 if slo_evaluator is not None:
                     payload["slo"] = slo_evaluator.report()
+                if prefill_client is not None:
+                    payload["prefill_offload"] = prefill_client.stats()
                 self._json(200, payload)
             elif self.path == "/metrics":
                 body = telemetry.render_prometheus().encode()
@@ -308,6 +312,14 @@ def _make_handler(scheduler: SlotScheduler, slo_evaluator=None):
                 })
                 return
             timeout_s = body.get("timeout_s")
+            # Two-stage dispatch (docs/Serving.md "Disaggregated
+            # prefill"): pull the prompt's KV blocks from the prefill
+            # tier BEFORE submitting, on THIS per-connection thread —
+            # the scheduler tick never waits on the hop, and admission's
+            # prefix hit then skips the shipped span. maybe_ship never
+            # raises: every failure mode degrades to local prefill.
+            if prefill_client is not None:
+                prefill_client.maybe_ship(prompt)
             # Cross-task tracing: the router (or any caller) supplies
             # X-Request-Id; it tags this replica's submit span and the
             # scheduler's trace-ring entries, and echoes back.
@@ -475,9 +487,23 @@ def run_serving(experiment, runtime=None) -> dict:
         slo_evaluator = telemetry.SloEvaluator(
             telemetry.parse_slo(experiment.slo)
         )
+    prefill_client = None
+    if getattr(experiment, "prefill_tier", None) is not None \
+            and experiment.kv_layout == "paged":
+        from tf_yarn_tpu.serving.prefill import (
+            PrefillClient,
+            parse_prefill_tier,
+        )
+
+        prefill_client = PrefillClient(
+            parse_prefill_tier(experiment.prefill_tier),
+            scheduler,
+            block_size=experiment.block_size,
+            kv=getattr(runtime, "kv", None),
+        )
     server = ServingServer(
         scheduler, experiment.host, experiment.port,
-        slo_evaluator=slo_evaluator,
+        slo_evaluator=slo_evaluator, prefill_client=prefill_client,
     )
     scheduler.start()
     endpoint = server.start()

@@ -1,0 +1,68 @@
+"""Task program for the ``prefill`` task type.
+
+The disaggregated-serving sibling of tasks/serving.py: bootstrap, pull
+the ServingExperiment from the KV store (the prefill tier serves the
+same model/checkpoint/paged-KV geometry its decode replicas do), and
+run the prefill replica body (`tf_yarn_tpu.serving.prefill.run_prefill`)
+under the same lifecycle events, heartbeats, and failure classification
+— a crashed prefill replica is classified through its stop event and
+relaunched by the driver's RetryPolicy, while its decode consumers
+degrade to local prefill the moment a ship fails (docs/Serving.md
+"Disaggregated prefill").
+
+SIGTERM (the TPU-VM preemption notice) flips the drain flag the serve
+loop polls AND ``/healthz`` to ``draining``, so decode replicas and the
+fleet registry stop dialing before the socket goes away.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tf_yarn_tpu import _task_commons, event, telemetry
+from tf_yarn_tpu._internal import MonitoredThread
+from tf_yarn_tpu.tasks import _bootstrap
+
+_logger = logging.getLogger(__name__)
+
+
+def _run(runtime: _bootstrap.TaskRuntime, experiment) -> None:
+    from tf_yarn_tpu import experiment as experiment_mod
+    from tf_yarn_tpu.serving.prefill import run_prefill
+
+    if not isinstance(experiment, experiment_mod.ServingExperiment):
+        raise TypeError(
+            f"prefill tasks expect a ServingExperiment, got "
+            f"{type(experiment)!r}"
+        )
+    run_prefill(experiment, runtime=runtime)
+
+
+def main() -> None:
+    from tf_yarn_tpu import preemption
+
+    preemption.install()
+    runtime = _bootstrap.init_runtime()
+    with _bootstrap.reporting_shutdown(runtime):
+        experiment = _task_commons.get_experiment(runtime.kv)
+        event.start_event(runtime.kv, runtime.task)
+        # MonitoredThread so the captured exception carries the replica
+        # stack into the stop event (classification reads it there).
+        thread = MonitoredThread(
+            target=_run,
+            args=(runtime, experiment),
+            name=f"prefill-{runtime.task}",
+        )
+        with telemetry.Heartbeat(
+            runtime.kv, runtime.task,
+            every=telemetry.heartbeat.every_from_env(),
+            registry=telemetry.get_registry(),
+        ):
+            thread.start()
+            thread.join()
+        if thread.exception is not None:
+            raise thread.exception
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,7 @@ MAX_CHIPS_PER_HOST = 8
 
 ALL_TASK_TYPES = {
     "chief", "worker", "evaluator", "tensorboard", "serving", "router",
-    "rank",
+    "rank", "prefill",
 }
 
 # Known slice shapes: name -> (total chips, hosts). Used by
@@ -183,6 +183,17 @@ def _check_general_topology(task_specs: TaskSpecs) -> None:
                 "instances >= 1 (topologies.fleet_topology / "
                 "mixed_fleet_topology build the pairs)"
             )
+    if "prefill" in task_specs and task_specs["prefill"].instances > 0:
+        # A prefill tier only makes sense with decode consumers: its
+        # output is KV blocks pulled by generate replicas, never client
+        # responses.
+        if task_specs.get("serving", TaskSpec(instances=0)).instances < 1:
+            raise ValueError(
+                "a prefill tier needs at least one serving (decode) "
+                "replica to consume its KV blocks — add a 'serving' "
+                "spec with instances >= 1 "
+                "(topologies.disaggregated_topology builds the pair)"
+            )
 
 
 def check_topology(task_specs: TaskSpecs) -> None:
@@ -307,6 +318,49 @@ def fleet_topology(
         instances=1,
         label=NodeLabel.CPU,
     )
+    check_topology(specs)
+    return specs
+
+
+def disaggregated_topology(
+    n_prefill: int = 1,
+    n_decode: int = 1,
+    memory_gib: int = 32,
+    vcores: int = 16,
+    decode_chips_per_host: int = 1,
+    prefill_chips_per_host: int = 1,
+    prefill_memory_gib: Optional[int] = None,
+) -> TaskSpecs:
+    """Disaggregated serving (docs/Serving.md "Disaggregated prefill"):
+    `n_prefill` compute-sized prefill replicas feeding `n_decode`
+    memory-sized decode replicas over the content-addressed KV block
+    wire. Prefill replicas advertise ``{task}/prefill_endpoint``;
+    decode replicas discover them through the KV store and PULL — the
+    client-facing protocol (and any router in front) is unchanged, and
+    a tier scaled to zero just means decode prefills locally. The two
+    pools size independently: big-HBM prefill chips can feed many cheap
+    decode chips (the VirtualFlow posture, PAPERS.md)."""
+    if n_prefill < 0 or n_decode < 1:
+        raise ValueError(
+            f"need n_decode >= 1 and n_prefill >= 0, got "
+            f"n_prefill={n_prefill}, n_decode={n_decode}"
+        )
+    specs = serving_topology(
+        instances=n_decode,
+        memory_gib=memory_gib,
+        vcores=vcores,
+        chips_per_host=decode_chips_per_host,
+    )
+    if n_prefill:
+        specs["prefill"] = TaskSpec(
+            memory_gib=(prefill_memory_gib if prefill_memory_gib
+                        is not None else memory_gib),
+            vcores=vcores,
+            instances=n_prefill,
+            chips_per_host=prefill_chips_per_host,
+            label=NodeLabel.TPU if prefill_chips_per_host
+            else NodeLabel.CPU,
+        )
     check_topology(specs)
     return specs
 
